@@ -1,0 +1,25 @@
+"""dlrover_tpu — a TPU-native elastic distributed-training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of DLRover
+(elastic-training control plane + acceleration library):
+
+- per-job **master**: master-driven rendezvous, node health checks,
+  dynamic data sharding, auto-scaling, fault diagnosis
+  (``dlrover_tpu.master``),
+- per-host **elastic agent** (``tpurun``): launches and supervises
+  ``jax.distributed`` training processes, restarts them across
+  preemptions (``dlrover_tpu.agent``),
+- **Flash Checkpoint**: synchronous HBM→host-shared-memory pytree
+  snapshots, persisted asynchronously by the agent and restored from
+  memory in seconds (``dlrover_tpu.flash_ckpt``),
+- **auto_accelerate** strategy engine emitting GSPMD mesh +
+  NamedSharding specs instead of wrapper classes
+  (``dlrover_tpu.parallel``),
+- Pallas kernels (flash attention, quantized optimizer state) and a
+  distributed module zoo (``dlrover_tpu.ops``, ``dlrover_tpu.models``).
+
+Reference behaviour is documented per-module with ``file:line``
+citations into the DLRover snapshot at ``/root/reference``.
+"""
+
+__version__ = "0.1.0"

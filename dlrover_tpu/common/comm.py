@@ -1,0 +1,292 @@
+"""Socket message transport between master, agents and trainers.
+
+The reference runs a gRPC service with a single generic ``report``/
+``get`` RPC pair whose payloads are pickled dataclasses
+(``dlrover/proto/elastic_training.proto:31-34``,
+``dlrover/python/common/grpc.py``).  We keep exactly that contract —
+two verbs, typed dataclass payloads — over a plain threaded TCP server
+with length-prefixed frames: no proto codegen, same dispatch model, and
+the unpickler is restricted to the message schema so a stray client
+cannot execute arbitrary reduce callables.
+
+Frame format: 8-byte big-endian length + pickle of
+``(verb, node_id, node_type, message)``; response frame is a pickled
+response message (``get``) or a bool ack (``report``).
+"""
+
+import io
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+import traceback
+from typing import Optional
+
+from dlrover_tpu.common.constants import GRPC
+from dlrover_tpu.common.log import default_logger as logger
+
+_LEN = struct.Struct(">Q")
+_MAX_FRAME = GRPC.MAX_MESSAGE_BYTES
+
+# Strict allowlist: dataclass message schema, container/scalar literals,
+# and the numpy array reconstructors.  builtins is NOT broadly allowed —
+# getattr/__import__ would be a remote-code-execution hole.
+_ALLOWED_MODULE_PREFIXES = ("dlrover_tpu.",)
+_ALLOWED_GLOBALS = {
+    ("builtins", "set"),
+    ("builtins", "frozenset"),
+    ("builtins", "list"),
+    ("builtins", "dict"),
+    ("builtins", "tuple"),
+    ("builtins", "bytearray"),
+    ("builtins", "complex"),
+    ("builtins", "bool"),
+    ("builtins", "int"),
+    ("builtins", "float"),
+    ("builtins", "str"),
+    ("builtins", "bytes"),
+    ("builtins", "slice"),
+    ("builtins", "range"),
+    ("collections", "OrderedDict"),
+    ("collections", "defaultdict"),
+    ("collections", "deque"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        if module.startswith("numpy") and name in ("dtype", "ndarray"):
+            return super().find_class(module, name)
+        if any(module.startswith(p) for p in _ALLOWED_MODULE_PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"forbidden global {module}.{name} in message"
+        )
+
+
+def _loads(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(payload) > _MAX_FRAME:
+        raise ValueError(f"frame too large: {len(payload)} bytes")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket):
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > _MAX_FRAME:
+        raise ValueError(f"frame too large: {length} bytes")
+    return _loads(_recv_exact(sock, length))
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def addr_connected(addr: str, timeout: float = 2.0) -> bool:
+    """Telnet-style reachability probe (reference:
+    elastic_run.py:326 _check_to_use_dlrover_run)."""
+    try:
+        host, port = addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+class RequestHandler:
+    """Interface the server dispatches to (master servicer implements it)."""
+
+    def report(self, node_id: int, node_type: str, message) -> bool:
+        raise NotImplementedError
+
+    def get(self, node_id: int, node_type: str, message):
+        raise NotImplementedError
+
+
+class RemoteError(Exception):
+    """A handler-side failure, shipped as plain strings so it survives
+    pickling/allowlisting regardless of the original exception type."""
+
+    def __init__(self, type_name: str, message: str, tb: str = ""):
+        super().__init__(f"{type_name}: {message}")
+        self.type_name = type_name
+        self.remote_traceback = tb
+
+
+class _Connection(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: "MessageServer" = self.server  # type: ignore[assignment]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                frame = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                return
+            except Exception:
+                logger.exception("malformed frame; dropping connection")
+                return
+            try:
+                verb, node_id, node_type, message = frame
+                if verb == "get":
+                    resp = server.handler.get(node_id, node_type, message)
+                elif verb == "report":
+                    resp = server.handler.report(node_id, node_type, message)
+                else:
+                    resp = RemoteError("ValueError", f"unknown verb {verb!r}")
+            except Exception as e:
+                logger.exception("handler error for frame %r", frame[:1])
+                resp = RemoteError(
+                    type(e).__name__, str(e), traceback.format_exc()
+                )
+            try:
+                _send_frame(sock, resp)
+            except (ConnectionError, OSError):
+                return
+            except Exception:
+                # unpicklable handler response: report instead of dying
+                logger.exception("unpicklable response %r", type(resp))
+                try:
+                    _send_frame(
+                        sock,
+                        RemoteError(
+                            "PicklingError",
+                            f"unpicklable response of type {type(resp)}",
+                        ),
+                    )
+                except (ConnectionError, OSError):
+                    return
+
+
+class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MessageServer:
+    """Threaded request server (role of create_master_service,
+    reference servicer.py:630)."""
+
+    def __init__(self, port: int, handler: RequestHandler, host: str = "0.0.0.0"):
+        self.handler = handler
+        self._server = _ThreadingTCPServer((host, port), _Connection)
+        self._server.handler = handler  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+        self.port = self._server.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="message-server",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("MessageServer listening on port %s", self.port)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class MessageClient:
+    """Persistent client connection with retry (role of MasterClient's
+    channel layer, reference elastic_agent/master_client.py:28
+    retry_grpc_request)."""
+
+    def __init__(
+        self,
+        addr: str,
+        node_id: int = -1,
+        node_type: str = "",
+        timeout: float = 60.0,
+        retries: int = 10,
+    ):
+        self._addr = addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._timeout = timeout
+        self._retries = retries
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        host, port = self._addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _roundtrip(self, verb: str, message):
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                with self._lock:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_frame(
+                        self._sock,
+                        (verb, self._node_id, self._node_type, message),
+                    )
+                    resp = _recv_frame(self._sock)
+                if isinstance(resp, Exception):
+                    raise resp
+                return resp
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                with self._lock:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                backoff = min(0.5 * (2**attempt), 8.0)
+                logger.warning(
+                    "connection to %s failed (%s); retry %d/%d in %.1fs",
+                    self._addr, e, attempt + 1, self._retries, backoff,
+                )
+                time.sleep(backoff)
+        raise ConnectionError(
+            f"cannot reach master at {self._addr}: {last_err}"
+        )
+
+    def get(self, message):
+        return self._roundtrip("get", message)
+
+    def report(self, message) -> bool:
+        return bool(self._roundtrip("report", message))
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

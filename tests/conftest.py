@@ -1,0 +1,13 @@
+"""Test harness config: run JAX on a virtual 8-device CPU platform so
+multi-chip sharding logic is exercised without TPU hardware (same trick
+the driver's dryrun uses)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DLROVER_LOG_LEVEL", "WARNING")
