@@ -10,8 +10,12 @@ the unpickler is restricted to the message schema so a stray client
 cannot execute arbitrary reduce callables.
 
 Frame format: 8-byte big-endian length + pickle of
-``(verb, node_id, node_type, message)``; response frame is a pickled
-response message (``get``) or a bool ack (``report``).
+``(verb, node_id, node_type, req_id, message)``; response frame is a
+pickled response message (``get``) or a bool ack (``report``).  The
+``req_id`` makes retries safe: the server caches responses by id and
+replays them instead of re-executing a handler whose response frame was
+lost, so reconnect-and-resend is exactly-once for non-idempotent
+requests (KV ``add`` barriers, failure reports, queue gets).
 """
 
 import io
@@ -22,6 +26,8 @@ import struct
 import threading
 import time
 import traceback
+import uuid
+from collections import OrderedDict
 from typing import Optional
 
 from dlrover_tpu.common.constants import GRPC
@@ -140,6 +146,32 @@ class RemoteError(Exception):
         self.remote_traceback = tb
 
 
+class ResponseCache:
+    """LRU of response frames keyed by request id, shared by every
+    connection of a server, so a retried request is answered from cache
+    instead of re-executing its handler."""
+
+    def __init__(self, capacity: int = 8192):
+        self._capacity = capacity
+        self._cache: "OrderedDict[str, object]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, req_id: str):
+        with self._lock:
+            if req_id in self._cache:
+                self._cache.move_to_end(req_id)
+                return True, self._cache[req_id]
+            return False, None
+
+    def put(self, req_id: str, resp):
+        if not req_id:
+            return
+        with self._lock:
+            self._cache[req_id] = resp
+            while len(self._cache) > self._capacity:
+                self._cache.popitem(last=False)
+
+
 class _Connection(socketserver.BaseRequestHandler):
     def handle(self):
         server: "MessageServer" = self.server  # type: ignore[assignment]
@@ -154,13 +186,20 @@ class _Connection(socketserver.BaseRequestHandler):
                 logger.exception("malformed frame; dropping connection")
                 return
             try:
-                verb, node_id, node_type, message = frame
-                if verb == "get":
-                    resp = server.handler.get(node_id, node_type, message)
-                elif verb == "report":
-                    resp = server.handler.report(node_id, node_type, message)
-                else:
-                    resp = RemoteError("ValueError", f"unknown verb {verb!r}")
+                verb, node_id, node_type, req_id, message = frame
+                hit, resp = server.response_cache.get(req_id)
+                if not hit:
+                    if verb == "get":
+                        resp = server.handler.get(node_id, node_type, message)
+                    elif verb == "report":
+                        resp = server.handler.report(
+                            node_id, node_type, message
+                        )
+                    else:
+                        resp = RemoteError(
+                            "ValueError", f"unknown verb {verb!r}"
+                        )
+                    server.response_cache.put(req_id, resp)
             except Exception as e:
                 logger.exception("handler error for frame %r", frame[:1])
                 resp = RemoteError(
@@ -198,6 +237,7 @@ class MessageServer:
         self.handler = handler
         self._server = _ThreadingTCPServer((host, port), _Connection)
         self._server.handler = handler  # type: ignore[attr-defined]
+        self._server.response_cache = ResponseCache()  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
         self.port = self._server.server_address[1]
 
@@ -244,6 +284,9 @@ class MessageClient:
 
     def _roundtrip(self, verb: str, message):
         last_err: Optional[Exception] = None
+        # one id for all attempts: a retry of an executed-but-unacked
+        # request is answered from the server's response cache
+        req_id = uuid.uuid4().hex
         for attempt in range(self._retries):
             try:
                 with self._lock:
@@ -251,7 +294,7 @@ class MessageClient:
                         self._sock = self._connect()
                     _send_frame(
                         self._sock,
-                        (verb, self._node_id, self._node_type, message),
+                        (verb, self._node_id, self._node_type, req_id, message),
                     )
                     resp = _recv_frame(self._sock)
                 if isinstance(resp, Exception):

@@ -16,10 +16,16 @@ import queue
 import socket
 import threading
 import time
+import uuid
 from multiprocessing import resource_tracker, shared_memory
 from typing import Dict, Optional
 
-from dlrover_tpu.common.comm import RemoteError, _recv_frame, _send_frame
+from dlrover_tpu.common.comm import (
+    RemoteError,
+    ResponseCache,
+    _recv_frame,
+    _send_frame,
+)
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -48,6 +54,7 @@ class LocalSocketComm:
         self._create = create
         self._path = _socket_path(name)
         self._server: Optional[socket.socket] = None
+        self._response_cache = ResponseCache()
         if create:
             self._start_server()
 
@@ -78,16 +85,21 @@ class LocalSocketComm:
         with conn:
             while True:
                 try:
-                    request = _recv_frame(conn)
+                    req_id, request = _recv_frame(conn)
                 except (ConnectionError, OSError, EOFError):
                     return
                 except Exception:
                     logger.exception("bad IPC frame on %s", self._name)
                     return
-                try:
-                    resp = self._handle(request)
-                except Exception as e:  # surface handler errors to client
-                    resp = RemoteError(type(e).__name__, str(e))
+                # replay cached response for a retried request so
+                # non-idempotent ops (queue get/put) are exactly-once
+                hit, resp = self._response_cache.get(req_id)
+                if not hit:
+                    try:
+                        resp = self._handle(request)
+                    except Exception as e:  # surface errors to client
+                        resp = RemoteError(type(e).__name__, str(e))
+                    self._response_cache.put(req_id, resp)
                 try:
                     _send_frame(conn, resp)
                 except (ConnectionError, OSError):
@@ -100,12 +112,13 @@ class LocalSocketComm:
 
     def _request(self, *request, timeout: float = 300.0):
         deadline = time.monotonic() + timeout
+        req_id = uuid.uuid4().hex
         while True:
             try:
                 with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
                     s.settimeout(max(0.1, deadline - time.monotonic()))
                     s.connect(self._path)
-                    _send_frame(s, request)
+                    _send_frame(s, (req_id, request))
                     resp = _recv_frame(s)
                 if isinstance(resp, Exception):
                     raise resp
@@ -156,7 +169,11 @@ class SharedLock(LocalSocketComm):
             return ok
         if verb == "release":
             (_, owner) = request
-            if self._lock.locked():
+            # only the holder (or a force-release, e.g. agent cleanup
+            # after a trainer died) may release
+            if self._lock.locked() and (
+                owner == self._owner or owner == "__force__"
+            ):
                 self._owner = None
                 self._lock.release()
                 return True
@@ -182,8 +199,10 @@ class SharedLock(LocalSocketComm):
                 return False
             time.sleep(self._POLL_INTERVAL)
 
-    def release(self) -> bool:
-        owner = f"pid-{os.getpid()}"
+    def release(self, force: bool = False) -> bool:
+        """Release if held by this process; ``force=True`` breaks a
+        dead holder's lock (agent cleanup after a trainer crash)."""
+        owner = "__force__" if force else f"pid-{os.getpid()}"
         if self._create:
             return self._handle(("release", owner))
         return self._request("release", owner)

@@ -26,16 +26,21 @@ class CheckpointDeletionStrategy:
 
 class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
     """Keep checkpoints whose step % interval == 0, delete the rest
-    (reference: storage.py:203)."""
+    (reference: storage.py:203).  Deletion is deferred by one commit —
+    the step just persisted is never removed, only the previously
+    committed one once a newer checkpoint exists (reference
+    storage.py:301-305 tracks pre_step for exactly this)."""
 
     def __init__(self, keep_interval: int, checkpoint_dir: str):
-        self._keep_interval = keep_interval
+        self._keep_interval = max(1, keep_interval)
         self._dir = checkpoint_dir
+        self._pre_step = -1
 
     def clean_up(self, step: int, delete_fn):
-        if step % self._keep_interval == 0:
+        prev, self._pre_step = self._pre_step, step
+        if prev < 0 or prev == step or prev % self._keep_interval == 0:
             return
-        delete_fn(os.path.join(self._dir, str(step)))
+        delete_fn(os.path.join(self._dir, str(prev)))
 
 
 class KeepLatestStepStrategy(CheckpointDeletionStrategy):
@@ -136,11 +141,12 @@ class PosixDiskStorage(CheckpointStorage):
         return sorted(os.listdir(path))
 
     def commit(self, step: int, success: bool):
-        if success and self._deletion_strategy is not None:
-            try:
-                self._deletion_strategy.clean_up(step, self.safe_rmtree)
-            except Exception:
-                logger.exception("checkpoint clean-up failed for step %s", step)
+        if not success or self._deletion_strategy is None:
+            return
+        try:
+            self._deletion_strategy.clean_up(step, self.safe_rmtree)
+        except Exception:
+            logger.exception("checkpoint clean-up failed for step %s", step)
 
 
 def get_checkpoint_storage(

@@ -1,0 +1,183 @@
+"""Job managers: node lifecycle bookkeeping on the master.
+
+Role of ``dlrover/python/master/node/local_job_manager.py`` (and the
+registry half of ``dist_job_manager.py``): track every node's status,
+heartbeats and restart accounting, fire event callbacks (shard
+recycling, rendezvous membership) on failures, and decide
+relaunch-vs-abort with the error monitor.  The scheduler-backed
+distributed flavour (pod creation/watching) lives in
+:mod:`dlrover_tpu.master.node_manager`.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeEvent, new_worker
+from dlrover_tpu.master.error_monitor import ErrorMonitor
+
+
+class JobManager:
+    """Local/base job manager (reference ``LocalJobManager:175``)."""
+
+    def __init__(self, error_monitor: Optional[ErrorMonitor] = None):
+        self._lock = threading.Lock()
+        self._nodes: Dict[int, Node] = {}
+        self._error_monitor = error_monitor or ErrorMonitor()
+        self._stop = threading.Event()
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        # callbacks fired with NodeEvent on status transitions
+        self._event_callbacks: List[Callable[[NodeEvent], None]] = []
+        self.job_exit_reason = ""
+
+    # -- registry ----------------------------------------------------------
+
+    def add_node(self, node_type: str, node_id: int, rank: int = -1) -> Node:
+        with self._lock:
+            if node_id not in self._nodes:
+                node = new_worker(node_id, rank)
+                node.type = node_type
+                self._nodes[node_id] = node
+            return self._nodes[node_id]
+
+    def get_node(self, node_id: int) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def all_nodes(self) -> Dict[int, Node]:
+        with self._lock:
+            return dict(self._nodes)
+
+    def add_event_callback(self, cb: Callable[[NodeEvent], None]):
+        self._event_callbacks.append(cb)
+
+    # -- status flow -------------------------------------------------------
+
+    def update_node_status(
+        self,
+        node_id: int,
+        node_type: str,
+        status: str,
+        exit_reason: str = "",
+    ):
+        node = self.add_node(node_type, node_id)
+        old = node.status
+        if old == status:
+            return
+        node.update_status(status)
+        if exit_reason:
+            node.exit_reason = exit_reason
+        event_type = (
+            NodeEventType.DELETED
+            if status in NodeStatus.end_states()
+            else NodeEventType.MODIFIED
+        )
+        logger.info(
+            "node %s (%s): %s -> %s (%s)",
+            node_id,
+            node_type,
+            old,
+            status,
+            exit_reason,
+        )
+        self._fire(NodeEvent(event_type, node))
+
+    def _fire(self, event: NodeEvent):
+        for cb in self._event_callbacks:
+            try:
+                cb(event)
+            except Exception:
+                logger.exception("node event callback failed")
+
+    # -- heartbeats --------------------------------------------------------
+
+    def collect_heartbeat(self, node_id: int, timestamp: float = 0.0):
+        node = self.add_node(NodeType.WORKER, node_id)
+        node.heartbeat_time = timestamp or time.time()
+        if node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
+            self.update_node_status(node_id, node.type, NodeStatus.RUNNING)
+
+    def start_heartbeat_monitor(self):
+        self._heartbeat_thread = threading.Thread(
+            target=self._monitor_heartbeats,
+            name="heartbeat-monitor",
+            daemon=True,
+        )
+        self._heartbeat_thread.start()
+
+    def _monitor_heartbeats(self):
+        """Dead-node events after a silence window (reference
+        ``_monitor_node_heart_beat:355``, 300 s)."""
+        window = Context.instance().hang_detection_seconds
+        while not self._stop.wait(15.0):
+            now = time.time()
+            for node in self.all_nodes().values():
+                if (
+                    node.status == NodeStatus.RUNNING
+                    and node.heartbeat_time
+                    and now - node.heartbeat_time > window
+                ):
+                    logger.warning(
+                        "node %s heartbeat silent for %.0fs; marking failed",
+                        node.id,
+                        now - node.heartbeat_time,
+                    )
+                    self.update_node_status(
+                        node.id, node.type, NodeStatus.FAILED, "no-heartbeat"
+                    )
+
+    # -- failures ----------------------------------------------------------
+
+    def handle_failure(
+        self,
+        node_id: int,
+        restart_count: int,
+        error_data: str,
+        level: str,
+    ) -> bool:
+        """Returns whether the node may relaunch."""
+        node = self.add_node(NodeType.WORKER, node_id)
+        relaunch = self._error_monitor.process_error(
+            node_id, restart_count, error_data, level
+        )
+        node.inc_relaunch_count()
+        if node.exceeded_max_relaunch():
+            logger.error(
+                "node %s exceeded max relaunch (%d)",
+                node_id,
+                node.max_relaunch_count,
+            )
+            return False
+        return relaunch
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def all_workers_exited(self) -> bool:
+        nodes = [
+            n
+            for n in self.all_nodes().values()
+            if n.type == NodeType.WORKER
+        ]
+        return bool(nodes) and all(
+            n.status in NodeStatus.end_states() for n in nodes
+        )
+
+    def all_workers_succeeded(self) -> bool:
+        nodes = [
+            n
+            for n in self.all_nodes().values()
+            if n.type == NodeType.WORKER
+        ]
+        return bool(nodes) and all(
+            n.status == NodeStatus.SUCCEEDED for n in nodes
+        )
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,44 @@
+"""Master entry point: ``python -m dlrover_tpu.master.main``.
+
+Role of ``dlrover/python/master/main.py``: parse args, build the
+master for the target platform, serve until the job exits.
+"""
+
+import argparse
+import sys
+
+from dlrover_tpu.common.constants import DefaultPorts
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.master import JobMaster
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="dlrover_tpu job master")
+    parser.add_argument("--port", type=int, default=DefaultPorts.MASTER)
+    parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument("--job_name", type=str, default="local-job")
+    parser.add_argument(
+        "--platform",
+        type=str,
+        default="local",
+        choices=["local", "kubernetes", "ray"],
+    )
+    return parser.parse_args(argv)
+
+
+def run(args) -> int:
+    master = JobMaster(
+        port=args.port, node_num=args.node_num, job_name=args.job_name
+    )
+    master.prepare()
+    return master.run()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    logger.info("starting master with %s", vars(args))
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Per-job master objects.
+
+Role of ``dlrover/python/master/local_master.py`` +
+``dist_master.py``: owns every master subcomponent (job manager, both
+rendezvous managers, task manager, speed monitor, KV store, request
+server) and a main loop that polls for exit/hang conditions every 30 s
+(reference ``dist_master.py:211``).  ``LocalJobMaster`` is what
+``tpurun`` spawns on node rank 0 when no external master exists; the
+scheduler-backed distributed flavour adds node watching/scaling on top
+(see :mod:`dlrover_tpu.master.node_manager`).
+"""
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.comm import MessageServer, find_free_port
+from dlrover_tpu.common.constants import JobExitReason, RendezvousName
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.task_manager import TaskManager
+
+
+class JobMaster:
+    def __init__(
+        self,
+        port: int = 0,
+        node_num: int = 1,
+        job_name: str = "local-job",
+        coordinator_port: int = 0,
+    ):
+        self.job_name = job_name
+        self.node_num = node_num
+        self.speed_monitor = SpeedMonitor()
+        self.job_manager = JobManager()
+        self.task_manager = TaskManager()
+        self.kv_store = KVStoreService()
+        self.elastic_rdzv = ElasticTrainingRendezvousManager()
+        self.network_rdzv = NetworkCheckRendezvousManager()
+        self.rdzv_managers = {
+            RendezvousName.ELASTIC_TRAINING: self.elastic_rdzv,
+            RendezvousName.NETWORK_CHECK: self.network_rdzv,
+        }
+        coordinator_port = coordinator_port or find_free_port()
+        for mngr in self.rdzv_managers.values():
+            mngr.update_rdzv_params(
+                min_nodes=node_num, max_nodes=node_num, node_unit=1
+            )
+            mngr.set_coordinator_port(coordinator_port)
+        self.servicer = MasterServicer(
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            speed_monitor=self.speed_monitor,
+        )
+        self._server = MessageServer(port, self.servicer)
+        self.port = self._server.port
+        self._stop = threading.Event()
+        self._exit_code = 0
+        self._run_thread: Optional[threading.Thread] = None
+
+    def update_rdzv_params(
+        self, min_nodes: int, max_nodes: int, node_unit: int = 1
+    ):
+        for mngr in self.rdzv_managers.values():
+            mngr.update_rdzv_params(
+                min_nodes=min_nodes, max_nodes=max_nodes, node_unit=node_unit
+            )
+
+    def prepare(self):
+        self.task_manager.start()
+        self.job_manager.start_heartbeat_monitor()
+        self._server.start()
+        logger.info(
+            "master %s serving on port %s for %d node(s)",
+            self.job_name,
+            self.port,
+            self.node_num,
+        )
+
+    def run(self) -> int:
+        """Main poll loop (reference ``dist_master.py:211``)."""
+        ctx = Context.instance()
+        try:
+            while not self._stop.wait(ctx.seconds_to_check_hang):
+                if self.servicer.exit_requested:
+                    logger.info(
+                        "job exit requested: %s", self.servicer.exit_requested
+                    )
+                    break
+                if self.job_manager.all_workers_exited():
+                    if self.job_manager.all_workers_succeeded():
+                        self.job_manager.job_exit_reason = (
+                            JobExitReason.SUCCEEDED
+                        )
+                    else:
+                        self.job_manager.job_exit_reason = (
+                            JobExitReason.CODE_ERROR
+                        )
+                        self._exit_code = 1
+                    break
+                if self.speed_monitor.all_worker_hanged(ctx.hang_timeout):
+                    logger.error("all workers hanged; stopping job")
+                    self.job_manager.job_exit_reason = (
+                        JobExitReason.HANG_ERROR
+                    )
+                    self._exit_code = 1
+                    break
+                if self.task_manager.finished():
+                    logger.info("all dataset tasks completed")
+                    break
+        finally:
+            self.stop()
+        return self._exit_code
+
+    def run_in_thread(self):
+        self._run_thread = threading.Thread(
+            target=self.run, name="master-run", daemon=True
+        )
+        self._run_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop()
+
+
+# Back-compat aliases matching the reference's two flavours.
+LocalJobMaster = JobMaster

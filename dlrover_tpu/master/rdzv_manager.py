@@ -1,0 +1,330 @@
+"""Master-side rendezvous managers.
+
+Role of ``dlrover/python/master/elastic_training/rdzv_manager.py``:
+
+- :class:`ElasticTrainingRendezvousManager` collects joining agents
+  into a waiting pool and completes a round when every alive node has
+  joined, or when ``min_nodes`` joined and the waiting timeout lapsed;
+  the accepted count is rounded down to a multiple of ``node_unit``
+  (reference ``join_rendezvous:198``, ``_check_rdzv_completed:129``).
+  The completed world is ``{node_rank: local_world_size}`` plus a
+  ``jax.distributed`` coordinator address (lowest-rank node) — the TPU
+  analog of handing out a c10d store.
+- :class:`NetworkCheckRendezvousManager` drives the two-round pairwise
+  diagnosis (reference ``NetworkCheckRendezvousManager:349``): round 0
+  pairs neighbours ``(i, i+1)``; round 1 re-pairs nodes sorted by
+  elapsed time (fastest with slowest) so a faulty node lands in a group
+  with a known-good partner and can be isolated.  Stragglers are nodes
+  whose check elapsed exceeds ``straggler_factor ×`` median
+  (reference ``_detect_stragglers:550``).
+"""
+
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.constants import (
+    NetworkCheckConstant,
+    RendezvousConstant,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class NodeMeta:
+    node_id: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1
+    node_ip: str = ""
+    join_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class RendezvousParameters:
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: float = RendezvousConstant.WAITING_TIMEOUT
+    node_unit: int = 1
+
+
+class RendezvousManager:
+    """Shared mechanics of both rendezvous flavours."""
+
+    def __init__(self, name: str = ""):
+        self._name = name
+        self._lock = threading.Lock()
+        self._params = RendezvousParameters()
+        self._alive_nodes: Set[int] = set()
+        self._waiting_nodes: Dict[int, NodeMeta] = {}  # by node_rank
+        self._rdzv_nodes: Dict[int, NodeMeta] = {}
+        self._latest_rdzv_nodes: List[int] = []
+        self._rdzv_round = 0
+        self._start_waiting_time = 0.0
+        self._coordinator_port = 0
+
+    # -- configuration ----------------------------------------------------
+
+    def update_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = RendezvousConstant.WAITING_TIMEOUT,
+        node_unit: int = 1,
+    ):
+        with self._lock:
+            self._params = RendezvousParameters(
+                min_nodes, max_nodes, waiting_timeout, max(1, node_unit)
+            )
+
+    def set_coordinator_port(self, port: int):
+        self._coordinator_port = port
+
+    # -- node liveness (driven by the job manager) -------------------------
+
+    def add_alive_node(self, node_id: int):
+        with self._lock:
+            self._alive_nodes.add(node_id)
+
+    def remove_alive_node(self, node_id: int):
+        with self._lock:
+            self._alive_nodes.discard(node_id)
+            stale = [
+                rank
+                for rank, meta in self._waiting_nodes.items()
+                if meta.node_id == node_id
+            ]
+            for rank in stale:
+                del self._waiting_nodes[rank]
+
+    # -- join / completion -------------------------------------------------
+
+    def join_rendezvous(
+        self,
+        node_id: int,
+        node_rank: int,
+        local_world_size: int,
+        node_ip: str = "",
+    ) -> int:
+        with self._lock:
+            self._waiting_nodes[node_rank] = NodeMeta(
+                node_id=node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                node_ip=node_ip,
+            )
+            self._alive_nodes.add(node_id)
+            if not self._start_waiting_time:
+                self._start_waiting_time = time.time()
+            return self._rdzv_round
+
+    def _check_rdzv_completed(self) -> bool:
+        """Caller holds the lock.  Mirrors reference
+        ``_check_rdzv_completed:129``."""
+        waiting = len(self._waiting_nodes)
+        if waiting == 0:
+            return False
+        p = self._params
+        alive = max(len(self._alive_nodes), 1)
+        complete = False
+        if waiting >= min(alive, p.max_nodes) and waiting >= p.min_nodes:
+            complete = True
+        elif (
+            waiting >= p.min_nodes
+            and self._start_waiting_time
+            and time.time() - self._start_waiting_time > p.waiting_timeout
+        ):
+            complete = True
+        if not complete:
+            return False
+        # cap at max_nodes, then round down to a multiple of node_unit
+        unit = p.node_unit
+        accept = (min(waiting, p.max_nodes) // unit) * unit
+        if accept < max(p.min_nodes, 1):
+            return False
+        ranks = sorted(self._waiting_nodes.keys())[:accept]
+        self._rdzv_nodes = {r: self._waiting_nodes.pop(r) for r in ranks}
+        self._latest_rdzv_nodes = ranks
+        self._rdzv_round += 1
+        self._start_waiting_time = 0.0
+        logger.info(
+            "%s rendezvous round %d completed with nodes %s",
+            self._name,
+            self._rdzv_round,
+            ranks,
+        )
+        return True
+
+    def num_nodes_waiting(self) -> int:
+        """Agents poll this to detect pending membership changes
+        (reference servicer ``num_nodes_waiting``)."""
+        with self._lock:
+            return len(self._waiting_nodes)
+
+    def _world(self) -> Dict[int, int]:
+        return {
+            rank: meta.local_world_size
+            for rank, meta in sorted(self._rdzv_nodes.items())
+        }
+
+    def _coordinator(self) -> str:
+        if not self._rdzv_nodes:
+            return ""
+        first = self._rdzv_nodes[min(self._rdzv_nodes)]
+        host = first.node_ip or "127.0.0.1"
+        return f"{host}:{self._coordinator_port or 52525}"
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """Reference ``ElasticTrainingRendezvousManager:291``."""
+
+    def __init__(self):
+        super().__init__(name="elastic-training")
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int], str]:
+        """Returns (round, group, {node_rank: local_world_size},
+        coordinator_addr); the world is empty while the round is
+        incomplete and the agent polls again.  A node that re-joined
+        (elastic membership change) is in the waiting pool and only
+        sees the new round once it completes."""
+        with self._lock:
+            if node_rank in self._waiting_nodes:
+                self._check_rdzv_completed()
+            if node_rank in self._waiting_nodes:
+                return self._rdzv_round, 0, {}, ""
+            if node_rank in self._rdzv_nodes:
+                return self._rdzv_round, 0, self._world(), self._coordinator()
+            return self._rdzv_round, 0, {}, ""
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Reference ``NetworkCheckRendezvousManager:349``."""
+
+    def __init__(self):
+        super().__init__(name="network-check")
+        # per check-round status/elapsed: {round: {node_id: value}}
+        self._node_status: Dict[int, Dict[int, bool]] = {}
+        self._node_times: Dict[int, Dict[int, float]] = {}
+        self._check_round = 0
+        self._groups: List[List[int]] = []
+
+    def _group_nodes(self, ranks: List[int]) -> List[List[int]]:
+        """Round 0: neighbour pairs; round >0: sorted by previous
+        elapsed, pair fastest with slowest (reference
+        ``_group_nodes:408``)."""
+        if self._check_round > 0 and self._node_times.get(
+            self._check_round - 1
+        ):
+            prev = self._node_times[self._check_round - 1]
+            id_by_rank = {
+                r: self._rdzv_nodes[r].node_id for r in ranks
+            }
+            ranks = sorted(
+                ranks,
+                key=lambda r: prev.get(id_by_rank[r], 0.0),
+            )
+            groups = []
+            lo, hi = 0, len(ranks) - 1
+            while lo < hi:
+                groups.append([ranks[lo], ranks[hi]])
+                lo += 1
+                hi -= 1
+            if lo == hi:
+                if groups:
+                    groups[-1].append(ranks[lo])
+                else:
+                    groups.append([ranks[lo]])
+            return groups
+        groups = []
+        for i in range(0, len(ranks) - 1, 2):
+            groups.append([ranks[i], ranks[i + 1]])
+        if len(ranks) % 2 == 1:
+            if groups:
+                groups[-1].append(ranks[-1])
+            else:
+                groups.append([ranks[-1]])
+        return groups
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int], str]:
+        """Returns (round, group_index, world restricted to this node's
+        group, group coordinator)."""
+        with self._lock:
+            if node_rank in self._waiting_nodes:
+                if self._check_rdzv_completed():
+                    ranks = sorted(self._rdzv_nodes.keys())
+                    self._groups = self._group_nodes(ranks)
+                    self._check_round += 1
+            if node_rank in self._waiting_nodes:
+                return self._rdzv_round, 0, {}, ""
+            if node_rank in self._rdzv_nodes:
+                for idx, group in enumerate(self._groups):
+                    if node_rank in group:
+                        world = {
+                            r: self._rdzv_nodes[r].local_world_size
+                            for r in sorted(group)
+                        }
+                        first = self._rdzv_nodes[min(group)]
+                        host = first.node_ip or "127.0.0.1"
+                        port = (self._coordinator_port or 52525) + 1 + idx
+                        return (
+                            self._rdzv_round,
+                            idx,
+                            world,
+                            f"{host}:{port}",
+                        )
+            return self._rdzv_round, 0, {}, ""
+
+    def report_network_status(
+        self, node_id: int, normal: bool, elapsed: float
+    ):
+        with self._lock:
+            rnd = max(self._check_round - 1, 0)
+            self._node_status.setdefault(rnd, {})[node_id] = normal
+            self._node_times.setdefault(rnd, {})[node_id] = elapsed
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        """Fault = abnormal in the latest round AND in the previous
+        round (if one exists); a single-round abnormal result asks for
+        another round first (reference ``check_fault_node:507``)."""
+        with self._lock:
+            rnd = max(self._check_round - 1, 0)
+            cur = self._node_status.get(rnd, {})
+            expected = {m.node_id for m in self._rdzv_nodes.values()}
+            if expected and not expected.issubset(cur.keys()):
+                return [], "waiting-for-reports"
+            abnormal = sorted(n for n, ok in cur.items() if not ok)
+            if not abnormal:
+                return [], "all-normal"
+            if rnd == 0:
+                return abnormal, "need-second-round"
+            prev = self._node_status.get(rnd - 1, {})
+            confirmed = sorted(
+                n for n in abnormal if prev.get(n, True) is False
+            )
+            return confirmed, "confirmed"
+
+    def detect_stragglers(self) -> Tuple[List[int], float]:
+        """Nodes slower than ``straggler_factor ×`` median elapsed
+        (reference ``_detect_stragglers:550``)."""
+        with self._lock:
+            rnd = max(self._check_round - 1, 0)
+            times = self._node_times.get(rnd, {})
+            if len(times) < 2:
+                return [], 0.0
+            med = statistics.median(times.values())
+            if med <= 0:
+                return [], med
+            factor = NetworkCheckConstant.STRAGGLER_FACTOR
+            return (
+                sorted(n for n, t in times.items() if t > factor * med),
+                med,
+            )
+
+    def network_check_success(self) -> bool:
+        fault, reason = self.check_fault_node()
+        return not fault and reason in ("all-normal",)

@@ -1,0 +1,247 @@
+"""Master request servicer: single report/get dispatch hub.
+
+Role of ``dlrover/python/master/servicer.py``: every agent/trainer
+message lands here and is dispatched by dataclass type to rendezvous
+managers, the KV store, the task manager, the job manager and the
+monitors.  The reference dispatches ~40 pickled message types through
+one gRPC ``report``/``get`` pair (``servicer.py:98,296``); this is the
+same design over the socket transport.
+"""
+
+import time
+from typing import Dict
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RequestHandler
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.task_manager import TaskManager
+
+
+class MasterServicer(RequestHandler):
+    def __init__(
+        self,
+        task_manager: TaskManager,
+        job_manager: JobManager,
+        rdzv_managers: Dict[str, RendezvousManager],
+        kv_store: KVStoreService,
+        speed_monitor: SpeedMonitor,
+    ):
+        self._task_manager = task_manager
+        self._job_manager = job_manager
+        self._rdzv_managers = rdzv_managers
+        self._kv_store = kv_store
+        self._speed_monitor = speed_monitor
+        self._paral_config = msg.ParallelConfig()
+        self.diagnosis_records = []
+        self.resource_stats: Dict[int, msg.NodeResourceStats] = {}
+        self.model_info = msg.ModelInfo()
+        self._exit_reason = ""
+
+    @property
+    def elastic_rdzv(self) -> ElasticTrainingRendezvousManager:
+        return self._rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+
+    @property
+    def network_rdzv(self) -> NetworkCheckRendezvousManager:
+        return self._rdzv_managers[RendezvousName.NETWORK_CHECK]
+
+    # ------------------------------------------------------------------
+    # get: request/response
+    # ------------------------------------------------------------------
+
+    def get(self, node_id: int, node_type: str, message):
+        if isinstance(message, msg.JoinRendezvousRequest):
+            mngr = self._rdzv_managers[
+                message.rdzv_name or RendezvousName.ELASTIC_TRAINING
+            ]
+            round_ = mngr.join_rendezvous(
+                message.node_id,
+                message.node_rank,
+                message.local_world_size,
+                message.node_ip,
+            )
+            self._job_manager.collect_heartbeat(message.node_id)
+            return msg.JoinRendezvousResponse(round=round_)
+
+        if isinstance(message, msg.CommWorldRequest):
+            mngr = self._rdzv_managers[
+                message.rdzv_name or RendezvousName.ELASTIC_TRAINING
+            ]
+            round_, group, world, coordinator = mngr.get_comm_world(
+                message.node_rank
+            )
+            return msg.CommWorldResponse(
+                rdzv_round=round_,
+                group=group,
+                world=world,
+                coordinator=coordinator,
+            )
+
+        if isinstance(message, msg.NumNodesWaitingRequest):
+            mngr = self._rdzv_managers[
+                message.rdzv_name or RendezvousName.ELASTIC_TRAINING
+            ]
+            return msg.NumNodesWaitingResponse(
+                num_nodes=mngr.num_nodes_waiting()
+            )
+
+        if isinstance(message, msg.NetworkCheckResultRequest):
+            fault, reason = self.network_rdzv.check_fault_node()
+            stragglers, _ = self.network_rdzv.detect_stragglers()
+            return msg.NetworkCheckResultResponse(
+                normal=message.node_id not in fault,
+                fault_nodes=fault,
+                straggler_nodes=stragglers,
+                reason=reason,
+            )
+
+        if isinstance(message, msg.KeyValueGetRequest):
+            return msg.KeyValuePair(
+                key=message.key, value=self._kv_store.get(message.key)
+            )
+
+        if isinstance(message, msg.KeyValueAddRequest):
+            return msg.KeyValueAddResponse(
+                value=self._kv_store.add(message.key, message.amount)
+            )
+
+        if isinstance(message, msg.GetShardTaskRequest):
+            return self._task_manager.get_dataset_task(
+                message.worker_id, message.dataset_name
+            )
+
+        if isinstance(message, msg.DatasetCheckpointRequest):
+            return msg.DatasetCheckpointResponse(
+                content=self._task_manager.get_dataset_checkpoint(
+                    message.dataset_name
+                )
+            )
+
+        if isinstance(message, msg.ParallelConfigRequest):
+            return self._paral_config
+
+        if isinstance(message, msg.HeartbeatRequest):
+            self._job_manager.collect_heartbeat(
+                message.node_id, message.timestamp
+            )
+            return msg.HeartbeatResponse()
+
+        if isinstance(message, msg.NodeFailure):
+            relaunch = self._job_manager.handle_failure(
+                message.node_id,
+                message.restart_count,
+                message.error_data,
+                message.level,
+            )
+            # failed node's shards go back to the queue
+            self._task_manager.recycle_worker_tasks(message.node_id)
+            self.elastic_rdzv.remove_alive_node(message.node_id)
+            self._speed_monitor.remove_running_worker(message.node_id)
+            return msg.BaseResponse(success=relaunch)
+
+        logger.warning("unhandled get message %s", type(message).__name__)
+        return msg.BaseResponse(
+            success=False, message=f"unhandled {type(message).__name__}"
+        )
+
+    # ------------------------------------------------------------------
+    # report: fire-and-ack
+    # ------------------------------------------------------------------
+
+    def report(self, node_id: int, node_type: str, message) -> bool:
+        if isinstance(message, msg.DatasetShardParams):
+            self._task_manager.new_dataset(message)
+            if message.batch_size:
+                self._speed_monitor.set_batch_size(message.batch_size)
+            return True
+
+        if isinstance(message, msg.ReportTaskResultRequest):
+            return self._task_manager.report_dataset_task(
+                message.dataset_name, message.task_id, message.success
+            )
+
+        if isinstance(message, msg.RestoreDatasetCheckpointRequest):
+            return self._task_manager.restore_dataset_from_checkpoint(
+                message.dataset_name, message.content
+            )
+
+        if isinstance(message, msg.KeyValuePair):
+            self._kv_store.set(message.key, message.value)
+            return True
+
+        if isinstance(message, msg.GlobalStepRecord):
+            self._speed_monitor.collect_global_step(
+                message.global_step, message.timestamp
+            )
+            self._job_manager.collect_heartbeat(message.node_id)
+            return True
+
+        if isinstance(message, msg.HeartbeatRequest):
+            self._job_manager.collect_heartbeat(
+                message.node_id, message.timestamp
+            )
+            return True
+
+        if isinstance(message, msg.NetworkStatusRequest):
+            self.network_rdzv.report_network_status(
+                message.node_id, message.normal, message.elapsed_time
+            )
+            return True
+
+        if isinstance(message, msg.NodeEventReport):
+            self._job_manager.update_node_status(
+                message.node_id,
+                message.node_type,
+                message.status,
+                message.exit_reason,
+            )
+            if message.status == "running":
+                self.elastic_rdzv.add_alive_node(message.node_id)
+                self._speed_monitor.add_running_worker(message.node_id)
+            elif message.status in ("failed", "deleted", "succeeded"):
+                self.elastic_rdzv.remove_alive_node(message.node_id)
+                self._speed_monitor.remove_running_worker(message.node_id)
+                self._task_manager.recycle_worker_tasks(message.node_id)
+            return True
+
+        if isinstance(message, msg.NodeResourceStats):
+            self.resource_stats[message.node_id] = message
+            return True
+
+        if isinstance(message, msg.ModelInfo):
+            self.model_info = message
+            return True
+
+        if isinstance(message, msg.DiagnosisData):
+            self.diagnosis_records.append(message)
+            return True
+
+        if isinstance(message, msg.ParallelConfig):
+            self._paral_config = message
+            return True
+
+        if isinstance(message, msg.ReadyToExitRequest):
+            self._job_manager.update_node_status(
+                message.node_id, "worker", "succeeded"
+            )
+            return True
+
+        if isinstance(message, msg.JobExitRequest):
+            self._exit_reason = message.reason or "requested"
+            return True
+
+        logger.warning("unhandled report message %s", type(message).__name__)
+        return False
+
+    @property
+    def exit_requested(self) -> str:
+        return self._exit_reason

@@ -1,0 +1,91 @@
+"""Training-speed accounting on the master.
+
+Role of ``dlrover/python/master/monitor/speed_monitor.py``: agents
+report the trainer's global step; the master derives steps/sec and
+samples/sec over a sliding window, tracks the globally completed step
+(used by hang detection and checkpoint naming), and exposes windows in
+which worker membership changed so throughput comparisons skip them.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Set, Tuple
+
+
+class SpeedMonitor:
+    def __init__(self, window: int = 50):
+        self._lock = threading.Lock()
+        # (timestamp, global_step) samples
+        self._samples: Deque[Tuple[float, int]] = deque(maxlen=window)
+        self._global_step = 0
+        self._start_time = time.time()
+        self._last_step_time = time.time()
+        self._batch_size = 0
+        self._worker_adjustment_time = 0.0
+        self._running_workers: Set[int] = set()
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = batch_size
+
+    def collect_global_step(self, step: int, timestamp: float = 0.0):
+        ts = timestamp or time.time()
+        with self._lock:
+            if step > self._global_step:
+                self._global_step = step
+                self._last_step_time = ts
+                self._samples.append((ts, step))
+
+    @property
+    def completed_global_step(self) -> int:
+        with self._lock:
+            return self._global_step
+
+    @property
+    def last_step_time(self) -> float:
+        with self._lock:
+            return self._last_step_time
+
+    def running_speed(self) -> float:
+        """Steps/sec over the sample window."""
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            (t0, s0), (t1, s1) = self._samples[0], self._samples[-1]
+            if t1 <= t0:
+                return 0.0
+            return (s1 - s0) / (t1 - t0)
+
+    def samples_per_second(self) -> float:
+        return self.running_speed() * self._batch_size
+
+    # -- membership-change windows ----------------------------------------
+
+    def add_running_worker(self, node_id: int):
+        with self._lock:
+            self._running_workers.add(node_id)
+            self._worker_adjustment_time = time.time()
+
+    def remove_running_worker(self, node_id: int):
+        with self._lock:
+            self._running_workers.discard(node_id)
+            self._worker_adjustment_time = time.time()
+
+    @property
+    def running_workers(self) -> Set[int]:
+        with self._lock:
+            return set(self._running_workers)
+
+    def worker_adjustment_finished(self, settle_seconds: float = 60.0) -> bool:
+        with self._lock:
+            if not self._worker_adjustment_time:
+                return True
+            return time.time() - self._worker_adjustment_time > settle_seconds
+
+    def all_worker_hanged(self, timeout: float = 1800.0) -> bool:
+        """No step progress for ``timeout`` seconds despite running
+        workers (feeds ``dist_master`` hang polling)."""
+        with self._lock:
+            if not self._running_workers or not self._samples:
+                return False
+            return time.time() - self._last_step_time > timeout

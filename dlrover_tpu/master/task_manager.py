@@ -1,0 +1,272 @@
+"""Server side of dynamic data sharding.
+
+Role of ``dlrover/python/master/shard/task_manager.py`` +
+``batch_dataset_manager.py``: per-dataset shard task queues, dispatch to
+whichever worker asks, ack on completion, timeout-based reassignment of
+tasks whose worker died or stalled, and dataset position
+checkpoint/restore so a relaunched job resumes mid-epoch.
+"""
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import DatasetShardParams, ShardTask
+from dlrover_tpu.master.dataset_splitter import (
+    DatasetSplitter,
+    Shard,
+    new_dataset_splitter,
+)
+
+_TASK_TIMEOUT = 1800.0
+
+
+@dataclass
+class _DoingTask:
+    task: ShardTask
+    worker_id: int
+    start_time: float = field(default_factory=time.time)
+
+
+class BatchDatasetManager:
+    """Dispatches one dataset's shard tasks (reference
+    ``batch_dataset_manager.py:203``)."""
+
+    def __init__(self, task_type: str, splitter: DatasetSplitter):
+        self.task_type = task_type
+        self.splitter = splitter
+        self.todo: List[ShardTask] = []
+        self.doing: Dict[int, _DoingTask] = {}
+        self._task_id = 0
+        self._completed_count = 0
+        # last successful ack — hang detection keys off real progress,
+        # not dispatch (a worker looping fetch-without-ack must still
+        # read as hung even while reassignment cycles its tasks)
+        self.last_ack_time = time.time()
+
+    def _fill_todo(self):
+        if self.todo or self.doing:
+            return
+        if self.splitter.epoch_finished():
+            return
+        self.splitter.create_shards()
+        for shard in self.splitter.get_shards():
+            self.todo.append(
+                ShardTask(
+                    task_id=self._task_id,
+                    task_type=self.task_type,
+                    dataset_name=self.splitter.dataset_name,
+                    start=shard.start,
+                    end=shard.end,
+                    indices=shard.indices,
+                )
+            )
+            self._task_id += 1
+
+    def get_task(self, worker_id: int) -> ShardTask:
+        self._fill_todo()
+        if not self.todo:
+            if self.doing:
+                return ShardTask(task_id=-1, task_type=TaskType.WAIT)
+            return ShardTask(task_id=-1, task_type=TaskType.NONE)
+        task = self.todo.pop(0)
+        self.doing[task.task_id] = _DoingTask(task, worker_id)
+        return task
+
+    def report_task(self, task_id: int, success: bool) -> bool:
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return False
+        if success:
+            self._completed_count += 1
+            self.last_ack_time = time.time()
+        else:
+            self.todo.insert(0, doing.task)
+        return True
+
+    def recycle_worker_tasks(self, worker_id: int):
+        """Return a dead worker's shards to the queue (reference
+        TaskRescheduleCallback behaviour)."""
+        stale = [
+            tid
+            for tid, d in self.doing.items()
+            if d.worker_id == worker_id
+        ]
+        for tid in stale:
+            self.todo.insert(0, self.doing.pop(tid).task)
+        if stale:
+            logger.info(
+                "recycled %d tasks of worker %s on dataset %s",
+                len(stale),
+                worker_id,
+                self.splitter.dataset_name,
+            )
+
+    def reassign_timeout_tasks(self, timeout: float = _TASK_TIMEOUT):
+        now = time.time()
+        stale = [
+            tid
+            for tid, d in self.doing.items()
+            if now - d.start_time > timeout
+        ]
+        for tid in stale:
+            self.todo.insert(0, self.doing.pop(tid).task)
+
+    def completed(self) -> bool:
+        return (
+            self.splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+    @property
+    def completed_count(self) -> int:
+        return self._completed_count
+
+    def checkpoint(self) -> Dict:
+        """Doing tasks fold back into todo: an un-acked shard is redone
+        after restore (reference ``get_dataset_checkpoint:243``)."""
+        todo = [
+            (t.task.start, t.task.end) for t in self.doing.values()
+        ] + [(t.start, t.end) for t in self.todo]
+        return {
+            "dataset": self.splitter.dataset_name,
+            "epoch": self.splitter.epoch,
+            "completed": self._completed_count,
+            "todo": todo,
+        }
+
+    def restore(self, state: Dict):
+        self.splitter.epoch = state.get("epoch", 0)
+        self._completed_count = state.get("completed", 0)
+        self.todo = []
+        self.doing = {}
+        for start, end in state.get("todo", []):
+            self.todo.append(
+                ShardTask(
+                    task_id=self._task_id,
+                    task_type=self.task_type,
+                    dataset_name=self.splitter.dataset_name,
+                    start=start,
+                    end=end,
+                )
+            )
+            self._task_id += 1
+
+
+class TaskManager:
+    """Owns every dataset's manager (reference ``TaskManager:37``)."""
+
+    def __init__(self, worker_restart_timeout: float = 0.0):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._worker_restart_timeout = worker_restart_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # speed-monitor hook: set by the master so task completion can
+        # feed throughput accounting
+        self.speed_monitor = None
+
+    def new_dataset(self, params: DatasetShardParams):
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return
+            splitter = new_dataset_splitter(
+                storage_type=params.storage_type,
+                shuffle=params.shuffle,
+                batch_size=params.batch_size,
+                dataset_size=params.dataset_size,
+                num_epochs=params.num_epochs,
+                dataset_name=params.dataset_name,
+                num_minibatches_per_shard=params.num_minibatches_per_shard,
+            )
+            self._datasets[params.dataset_name] = BatchDatasetManager(
+                params.task_type or TaskType.TRAINING, splitter
+            )
+            logger.info("new dataset %s registered", params.dataset_name)
+
+    def get_dataset_task(
+        self, worker_id: int, dataset_name: str
+    ) -> ShardTask:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return ShardTask(task_id=-1, task_type=TaskType.NONE)
+            return ds.get_task(worker_id)
+
+    def report_dataset_task(
+        self, dataset_name: str, task_id: int, success: bool
+    ) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return False
+            return ds.report_task(task_id, success)
+
+    def recycle_worker_tasks(self, worker_id: int):
+        with self._lock:
+            for ds in self._datasets.values():
+                ds.recycle_worker_tasks(worker_id)
+
+    def finished(self) -> bool:
+        with self._lock:
+            if not self._datasets:
+                return False
+            return all(ds.completed() for ds in self._datasets.values())
+
+    def get_dataset_checkpoint(self, dataset_name: str) -> str:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return ""
+            return json.dumps(ds.checkpoint())
+
+    def restore_dataset_from_checkpoint(
+        self, dataset_name: str, content: str
+    ) -> bool:
+        if not content:
+            return False
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return False
+            ds.restore(json.loads(content))
+            return True
+
+    # -- timeout reassignment thread --------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._reassign_loop, name="task-reassign", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _reassign_loop(self):
+        while not self._stop.wait(30.0):
+            with self._lock:
+                for ds in self._datasets.values():
+                    ds.reassign_timeout_tasks()
+
+    def task_hanged(self, timeout: float = 1800.0) -> bool:
+        """True when a dataset has work in flight or pending but no
+        shard was successfully acked for ``timeout`` seconds (feeds
+        master hang detection; reference ``task_manager.py:145``).
+        Keyed off ack time, not dispatch time, so the periodic
+        reassignment of stale tasks cannot mask the hang."""
+        now = time.time()
+        with self._lock:
+            if not self._datasets:
+                return False
+            for ds in self._datasets.values():
+                if (ds.doing or ds.todo) and (
+                    now - ds.last_ack_time > timeout
+                ):
+                    return True
+            return False
