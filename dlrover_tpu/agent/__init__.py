@@ -1,0 +1,2 @@
+"""Elastic agent: the per-host daemon between the job master and the
+training processes (reference: ``dlrover/python/elastic_agent/``)."""

@@ -1,0 +1,347 @@
+"""Singleton client wrapping every master RPC.
+
+Reference: ``dlrover/python/elastic_agent/master_client.py:50``
+(``MasterClient`` + ``retry_grpc_request:28``).  One typed method per
+control-plane interaction — rendezvous join/poll, KV store, shard
+tasks, metrics, failures, heartbeats — all over the two-verb
+report/get transport, with uniform retry.
+"""
+
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import MessageClient
+from dlrover_tpu.common.constants import NodeEnv, NodeType, TaskType
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def retry_request(func):
+    """Retry an RPC a few times before giving up (reference:
+    ``retry_grpc_request``, master_client.py:28)."""
+
+    def wrapped(self, *args, **kwargs):
+        retry = 3
+        last_exc: Optional[Exception] = None
+        for i in range(retry):
+            try:
+                return func(self, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - transport errors vary
+                last_exc = e
+                logger.warning(
+                    "RPC %s failed (attempt %s/%s): %s",
+                    func.__name__, i + 1, retry, e,
+                )
+                time.sleep(1 + i * 2)
+        raise RuntimeError(
+            f"RPC {func.__name__} failed after {retry} attempts"
+        ) from last_exc
+
+    return wrapped
+
+
+class MasterClient:
+    """Typed facade over the master's report/get service."""
+
+    _instance: Optional["MasterClient"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, master_addr: str, node_id: int, node_type: str):
+        self._addr = master_addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._client = MessageClient(master_addr, node_id, node_type)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def singleton(cls) -> "MasterClient":
+        with cls._lock:
+            if cls._instance is None:
+                addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+                if not addr:
+                    raise RuntimeError(
+                        f"{NodeEnv.MASTER_ADDR} is not set; cannot reach "
+                        "the job master"
+                    )
+                cls._instance = cls(
+                    addr,
+                    env_utils.get_node_id(),
+                    os.getenv("DLROVER_NODE_TYPE", NodeType.WORKER),
+                )
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.close()
+            cls._instance = None
+
+    @property
+    def node_id(self) -> int:
+        return self._node_id
+
+    @property
+    def master_addr(self) -> str:
+        return self._addr
+
+    def close(self):
+        self._client.close()
+
+    # -- rendezvous --------------------------------------------------------
+
+    @retry_request
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str,
+        node_ip: str = "",
+    ) -> int:
+        req = msg.JoinRendezvousRequest(
+            node_id=self._node_id,
+            node_rank=node_rank,
+            local_world_size=local_world_size,
+            rdzv_name=rdzv_name,
+            node_ip=node_ip or socket.gethostbyname(socket.gethostname()),
+        )
+        resp: msg.JoinRendezvousResponse = self._client.get(req)
+        return resp.round
+
+    @retry_request
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int], str]:
+        req = msg.CommWorldRequest(
+            node_id=self._node_id, node_rank=node_rank, rdzv_name=rdzv_name
+        )
+        resp: msg.CommWorldResponse = self._client.get(req)
+        return resp.rdzv_round, resp.group, resp.world, resp.coordinator
+
+    @retry_request
+    def num_nodes_waiting(self, rdzv_name: str) -> int:
+        resp: msg.NumNodesWaitingResponse = self._client.get(
+            msg.NumNodesWaitingRequest(rdzv_name=rdzv_name)
+        )
+        return resp.num_nodes
+
+    @retry_request
+    def network_ready(self) -> bool:
+        resp = self._client.get(msg.NetworkReadyRequest())
+        return bool(resp.success)
+
+    @retry_request
+    def report_network_status(
+        self, node_id: int, normal: bool, elapsed_time: float
+    ) -> bool:
+        return self._client.report(
+            msg.NetworkStatusRequest(
+                node_id=node_id, normal=normal, elapsed_time=elapsed_time
+            )
+        )
+
+    @retry_request
+    def check_fault_node(self) -> msg.NetworkCheckResultResponse:
+        return self._client.get(
+            msg.NetworkCheckResultRequest(node_id=self._node_id)
+        )
+
+    # -- KV store (rendezvous bootstrap / barriers) ------------------------
+
+    @retry_request
+    def kv_store_set(self, key: str, value: bytes) -> bool:
+        return self._client.report(msg.KeyValuePair(key=key, value=value))
+
+    @retry_request
+    def kv_store_get(self, key: str) -> bytes:
+        resp: msg.KeyValuePair = self._client.get(
+            msg.KeyValueGetRequest(key=key)
+        )
+        return resp.value
+
+    @retry_request
+    def kv_store_add(self, key: str, amount: int) -> int:
+        resp: msg.KeyValueAddResponse = self._client.get(
+            msg.KeyValueAddRequest(key=key, amount=amount)
+        )
+        return resp.value
+
+    # -- dynamic data sharding --------------------------------------------
+
+    @retry_request
+    def report_dataset_shard_params(
+        self,
+        batch_size: int,
+        num_epochs: int,
+        dataset_size: int,
+        shuffle: bool,
+        num_minibatches_per_shard: int,
+        dataset_name: str,
+        task_type: str = TaskType.TRAINING,
+        storage_type: str = "text",
+    ) -> bool:
+        return self._client.report(
+            msg.DatasetShardParams(
+                batch_size=batch_size,
+                num_epochs=num_epochs,
+                dataset_size=dataset_size,
+                shuffle=shuffle,
+                num_minibatches_per_shard=num_minibatches_per_shard,
+                dataset_name=dataset_name,
+                task_type=task_type,
+                storage_type=storage_type,
+            )
+        )
+
+    @retry_request
+    def get_task(self, dataset_name: str) -> msg.ShardTask:
+        return self._client.get(
+            msg.GetShardTaskRequest(
+                worker_id=self._node_id, dataset_name=dataset_name
+            )
+        )
+
+    @retry_request
+    def report_task_result(
+        self, dataset_name: str, task_id: int, success: bool = True,
+        error: str = "",
+    ) -> bool:
+        return self._client.report(
+            msg.ReportTaskResultRequest(
+                task_id=task_id,
+                dataset_name=dataset_name,
+                worker_id=self._node_id,
+                success=success,
+                error=error,
+            )
+        )
+
+    @retry_request
+    def get_dataset_checkpoint(self, dataset_name: str) -> str:
+        resp: msg.DatasetCheckpointResponse = self._client.get(
+            msg.DatasetCheckpointRequest(dataset_name=dataset_name)
+        )
+        return resp.content
+
+    @retry_request
+    def restore_dataset_checkpoint(
+        self, dataset_name: str, content: str
+    ) -> bool:
+        return self._client.report(
+            msg.RestoreDatasetCheckpointRequest(
+                dataset_name=dataset_name, content=content
+            )
+        )
+
+    # -- metrics / monitoring ---------------------------------------------
+
+    @retry_request
+    def report_global_step(self, global_step: int, timestamp: float = 0.0):
+        return self._client.report(
+            msg.GlobalStepRecord(
+                node_id=self._node_id,
+                global_step=global_step,
+                timestamp=timestamp or time.time(),
+            )
+        )
+
+    @retry_request
+    def report_resource_stats(
+        self,
+        cpu_percent: float,
+        memory_mb: float,
+        chip_stats: Optional[List[Dict[str, float]]] = None,
+    ):
+        return self._client.report(
+            msg.NodeResourceStats(
+                node_id=self._node_id,
+                node_type=self._node_type,
+                cpu_percent=cpu_percent,
+                memory_mb=memory_mb,
+                chip_stats=chip_stats or [],
+            )
+        )
+
+    @retry_request
+    def report_model_info(
+        self, num_params: int, dtype: str = "", flops_per_step: float = 0.0
+    ):
+        return self._client.report(
+            msg.ModelInfo(
+                num_params=num_params,
+                dtype=dtype,
+                flops_per_step=flops_per_step,
+            )
+        )
+
+    @retry_request
+    def report_heartbeat(self, timestamp: float = 0.0) -> str:
+        resp: msg.HeartbeatResponse = self._client.get(
+            msg.HeartbeatRequest(
+                node_id=self._node_id, timestamp=timestamp or time.time()
+            )
+        )
+        return resp.action
+
+    # -- failure / lifecycle ----------------------------------------------
+
+    @retry_request
+    def report_failure(
+        self, error_data: str, level: str, restart_count: int = 0,
+        node_rank: int = -1,
+    ) -> bool:
+        return self._client.report(
+            msg.NodeFailure(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            )
+        )
+
+    @retry_request
+    def report_diagnosis_data(self, data_type: str, content: str) -> bool:
+        return self._client.report(
+            msg.DiagnosisData(
+                node_id=self._node_id,
+                data_type=data_type,
+                content=content,
+                timestamp=time.time(),
+            )
+        )
+
+    @retry_request
+    def report_node_event(
+        self, event_type: str, status: str, exit_reason: str = ""
+    ) -> bool:
+        return self._client.report(
+            msg.NodeEventReport(
+                node_id=self._node_id,
+                node_type=self._node_type,
+                event_type=event_type,
+                status=status,
+                exit_reason=exit_reason,
+            )
+        )
+
+    @retry_request
+    def ready_to_exit(self, reason: str = "") -> bool:
+        return self._client.report(
+            msg.ReadyToExitRequest(node_id=self._node_id, reason=reason)
+        )
+
+    @retry_request
+    def get_parallel_config(self) -> msg.ParallelConfig:
+        return self._client.get(
+            msg.ParallelConfigRequest(node_id=self._node_id)
+        )
+
+    @retry_request
+    def report_job_exit(self, reason: str) -> bool:
+        return self._client.report(msg.JobExitRequest(reason=reason))

@@ -1,0 +1,105 @@
+"""Node health-check payload: per-chip compute benchmark + cross-node
+sync probe.
+
+Reference: ``dlrover/trainer/torch/node_check/{utils,nvidia_gpu}.py``
+(matmul + 2^24-float allreduce per round) driven by
+``NodeCheckElasticAgent`` (``elastic_agent/torch/training.py:864``).
+On TPU the equivalent per-chip probe is a jitted bf16 matmul on every
+local device (exercises MXU + HBM); the cross-node probe is a
+KV-store barrier timed against the master (stand-in for an ICI/DCN
+collective when no global runtime is up — the real collective path is
+exercised by training itself).  Elapsed time is reported to the
+master's NetworkCheckRendezvousManager, which isolates fault nodes and
+stragglers (>2x median, rdzv_manager.py:550).
+
+Fault injection: ``MOCK_ERR_RANK`` makes the matching node rank raise,
+mirroring ``node_check/utils.py:49 mock_error()``.
+"""
+
+import os
+import time
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def mock_error():
+    """Raise if this node rank is marked faulty (test fault injection)."""
+    err_rank = os.getenv(NodeEnv.MOCK_ERR_RANK, "")
+    if err_rank and int(err_rank) == int(os.getenv(NodeEnv.NODE_RANK, "0")):
+        raise RuntimeError(f"mock error on rank {err_rank}")
+
+
+def bm_chip_matmul(size: int = 1024, rounds: int = 8) -> float:
+    """Time a jitted bf16 matmul chain on every local device.
+
+    A straggling or faulty chip shows up as a slow or failing device;
+    bf16 NxN matmuls land on the MXU so this measures the chip, not
+    Python.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    elapsed = 0.0
+    for dev in jax.local_devices():
+        x = jax.device_put(
+            jnp.ones((size, size), dtype=jnp.bfloat16), device=dev
+        )
+
+        @jax.jit
+        def chain(a):
+            for _ in range(4):
+                a = a @ a / size
+            return a
+
+        chain(x).block_until_ready()  # compile outside the timer
+        start = time.perf_counter()
+        for _ in range(rounds):
+            x = chain(x)
+        x.block_until_ready()
+        elapsed += time.perf_counter() - start
+    return elapsed
+
+
+def bm_sync_barrier(
+    client: MasterClient, round_id: int, world_size: int,
+    timeout: float = 300.0,
+) -> float:
+    """Timed all-nodes barrier through the master KV store.
+
+    Measures how long this node waits for every peer to arrive —
+    a slow peer inflates everyone's elapsed time except its own,
+    which combined with the matmul timing lets the master's 2-round
+    pairwise regrouping isolate the slow node.
+    """
+    key = f"node_check_barrier_{round_id}"
+    start = time.perf_counter()
+    client.kv_store_add(key, 1)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if client.kv_store_add(key, 0) >= world_size:
+            return time.perf_counter() - start
+        time.sleep(0.1)
+    raise TimeoutError(f"node-check barrier round {round_id} timed out")
+
+
+def run_node_check(
+    client: Optional[MasterClient] = None,
+    matmul_size: int = 1024,
+    world_size: int = 1,
+    round_id: int = 0,
+) -> float:
+    """Full check: fault injection hook, chip matmul, sync probe.
+
+    Returns elapsed seconds; raises on chip failure so the caller
+    reports abnormal status.
+    """
+    client = client or MasterClient.singleton()
+    mock_error()
+    elapsed = bm_chip_matmul(size=matmul_size)
+    if world_size > 1:
+        elapsed += bm_sync_barrier(client, round_id, world_size)
+    logger.info("node check elapsed %.3fs", elapsed)
+    return elapsed

@@ -1,0 +1,159 @@
+"""Worker side of dynamic data sharding.
+
+Reference: ``dlrover/python/elastic_agent/sharding/client.py:29,231``
+(``ShardingClient`` / ``IndexShardingClient``).  Workers pull shard
+tasks (index ranges) from the master, ack completed shards so the
+master can recycle a dead worker's outstanding shards, and checkpoint
+the dataset position.  ``IndexShardingClient`` flattens shards into a
+per-sample index stream with a prefetch thread, which is what elastic
+datasets consume.
+"""
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import ShardTask
+
+
+class ShardingClient:
+    """Shard-level client: get_task / report_task_result."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        batch_size: int,
+        num_epochs: int,
+        dataset_size: int,
+        shuffle: bool = False,
+        task_type: str = TaskType.TRAINING,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "text",
+        master_client: Optional[MasterClient] = None,
+    ):
+        self._client = master_client or MasterClient.singleton()
+        self.dataset_name = dataset_name
+        self.batch_size = batch_size
+        self._lock = threading.Lock()
+        self._current_task: Optional[ShardTask] = None
+        self._pending: List[ShardTask] = []
+        # Idempotent on the master side: the first worker to report wins.
+        self._client.report_dataset_shard_params(
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            dataset_size=dataset_size,
+            shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            dataset_name=dataset_name,
+            task_type=task_type,
+            storage_type=storage_type,
+        )
+
+    def fetch_task(self) -> Optional[ShardTask]:
+        """Fetch the next shard; None once the dataset is exhausted."""
+        while True:
+            task: ShardTask = self._client.get_task(self.dataset_name)
+            if task.task_type == TaskType.WAIT:
+                time.sleep(2)
+                continue
+            if task.task_id < 0:
+                return None
+            with self._lock:
+                self._pending.append(task)
+                self._current_task = task
+            return task
+
+    def report_task_done(
+        self, task_id: Optional[int] = None, success: bool = True,
+        error: str = "",
+    ):
+        with self._lock:
+            if task_id is None and self._pending:
+                task_id = self._pending[0].task_id
+            self._pending = [t for t in self._pending if t.task_id != task_id]
+        if task_id is not None:
+            self._client.report_task_result(
+                self.dataset_name, task_id, success=success, error=error
+            )
+
+    def get_checkpoint(self) -> str:
+        return self._client.get_dataset_checkpoint(self.dataset_name)
+
+    def restore_checkpoint(self, content: str):
+        self._client.restore_dataset_checkpoint(self.dataset_name, content)
+
+
+class IndexShardingClient(ShardingClient):
+    """Per-sample index stream over shard tasks with background
+    prefetch (reference: sharding/client.py:231)."""
+
+    def __init__(self, *args, prefetch_depth: int = 4096, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._index_queue: "queue.Queue[Optional[int]]" = queue.Queue(
+            maxsize=prefetch_depth
+        )
+        # Count of samples remaining in the shard currently being
+        # consumed; when it hits zero the shard is acked.
+        self._shard_remaining = 0
+        self._consuming_task_id = -1
+        self._consume_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._prefetch_thread = threading.Thread(
+            target=self._prefetch_loop, daemon=True, name="index-prefetch"
+        )
+        self._prefetch_thread.start()
+
+    def _prefetch_loop(self):
+        try:
+            while not self._stopped.is_set():
+                task = self.fetch_task()
+                if task is None:
+                    self._index_queue.put(None)
+                    return
+                indices = (
+                    task.indices
+                    if task.indices is not None
+                    else list(range(task.start, task.end))
+                )
+                for idx in indices:
+                    self._index_queue.put((task.task_id, idx))
+        except Exception as e:  # noqa: BLE001
+            logger.error("index prefetch thread died: %s", e)
+            self._index_queue.put(None)
+
+    def fetch_sample_index(self, timeout: float = 300.0) -> Optional[int]:
+        """Next global sample index, or None at end of data."""
+        item = self._index_queue.get(timeout=timeout)
+        if item is None:
+            return None
+        task_id, idx = item
+        with self._consume_lock:
+            if task_id != self._consuming_task_id:
+                self._consuming_task_id = task_id
+                self._shard_remaining = self._shard_size(task_id)
+        return idx
+
+    def _shard_size(self, task_id: int) -> int:
+        with self._lock:
+            for t in self._pending:
+                if t.task_id == task_id:
+                    return t.shard_size
+        return 0
+
+    def report_batch_done(self, batch_size: Optional[int] = None):
+        """Account consumed samples; ack the shard once fully consumed
+        (reference: client.py report_batch_done)."""
+        consumed = batch_size or self.batch_size
+        with self._consume_lock:
+            self._shard_remaining -= consumed
+            if self._shard_remaining <= 0 and self._consuming_task_id >= 0:
+                done_id = self._consuming_task_id
+                self._consuming_task_id = -1
+                self.report_task_done(done_id)
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,377 @@
+"""Elastic training agent: master-driven rendezvous, worker process
+supervision, restart-on-membership-change, failure reporting.
+
+Reference: ``dlrover/python/elastic_agent/torch/training.py``
+(``ElasticTrainingAgent:362``, ``_invoke_run:580``,
+``_membership_changed:711``, ``MasterRendezvousHandler:179``,
+``NodeCheckElasticAgent:864``).  The torch-elastic machinery is
+replaced by direct process supervision: after each master rendezvous
+the agent exports the ``jax.distributed.initialize`` coordinates
+(coordinator address, process_id, num_processes) and spawns the
+training processes; a monitor loop restarts them on failure or when
+the master reports waiting nodes (membership change).  The
+save-checkpoint-at-breakpoint hook fires before any restart so the
+shared-memory checkpoint is persisted even when the trainer died.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.monitor import (
+    HeartbeatReporter,
+    ResourceMonitor,
+    TrainingMonitor,
+)
+from dlrover_tpu.agent.node_check import run_node_check
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.constants import (
+    NetworkCheckConstant,
+    NodeEnv,
+    RendezvousConstant,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class WorkerState(Enum):
+    INIT = "init"
+    HEALTHY = "healthy"
+    FAILED = "failed"
+    SUCCEEDED = "succeeded"
+
+
+@dataclass
+class WorkerSpec:
+    """What to run and how elastic it is (reference: torch WorkerSpec +
+    ElasticLaunchConfig, elastic_run.py:295)."""
+
+    entrypoint: List[str] = field(default_factory=list)
+    nproc_per_node: int = 1
+    max_restarts: int = 3
+    monitor_interval: float = 2.0
+    min_nodes: int = 1
+    max_nodes: int = 1
+    node_unit: int = 1
+    rdzv_timeout: float = RendezvousConstant.DEFAULT_TIMEOUT
+    network_check: bool = False
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class RendezvousOutcome:
+    round: int = 0
+    world: Dict[int, int] = field(default_factory=dict)
+    coordinator: str = ""
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.world)
+
+    @property
+    def world_size(self) -> int:
+        return sum(self.world.values())
+
+    def base_rank(self, node_rank: int) -> int:
+        return sum(
+            size for rank, size in sorted(self.world.items())
+            if rank < node_rank
+        )
+
+
+class MasterRendezvousHandler:
+    """Join the master rendezvous and poll for the completed world
+    (reference: MasterRendezvousHandler.next_rendezvous,
+    training.py:250)."""
+
+    def __init__(
+        self,
+        name: str,
+        node_rank: int,
+        local_world_size: int,
+        client: Optional[MasterClient] = None,
+        timeout: float = RendezvousConstant.DEFAULT_TIMEOUT,
+    ):
+        self._name = name
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._client = client or MasterClient.singleton()
+        self._timeout = timeout
+
+    def next_rendezvous(self) -> RendezvousOutcome:
+        rdzv_round = self._client.join_rendezvous(
+            self._node_rank, self._local_world_size, self._name
+        )
+        start = time.time()
+        while True:
+            round_, _group, world, coordinator = self._client.get_comm_world(
+                self._name, self._node_rank
+            )
+            if world:
+                if self._node_rank not in world:
+                    raise RuntimeError(
+                        f"node {self._node_rank} excluded from rendezvous "
+                        f"round {round_} world {sorted(world)}"
+                    )
+                logger.info(
+                    "rendezvous %s round %s complete: %s nodes, "
+                    "coordinator %s",
+                    self._name, round_, len(world), coordinator,
+                )
+                return RendezvousOutcome(
+                    round=round_, world=world, coordinator=coordinator
+                )
+            if time.time() - start > self._timeout:
+                raise TimeoutError(
+                    f"rendezvous {self._name} round {rdzv_round} timed out "
+                    f"after {self._timeout}s"
+                )
+            time.sleep(RendezvousConstant.JOIN_INTERVAL)
+
+
+class ElasticTrainingAgent:
+    """Supervises the local training processes of one node."""
+
+    def __init__(
+        self,
+        spec: WorkerSpec,
+        client: Optional[MasterClient] = None,
+        node_rank: Optional[int] = None,
+        start_monitors: bool = True,
+        # hook run before any restart/exit so shm checkpoints persist
+        # (reference: _save_ckpt_to_storage at training.py:665)
+        save_ckpt_hook: Optional[Callable[[], None]] = None,
+    ):
+        self._spec = spec
+        self._client = client or MasterClient.singleton()
+        self._node_rank = (
+            node_rank if node_rank is not None else env_utils.get_node_rank()
+        )
+        self._restart_count = 0
+        self._procs: List[subprocess.Popen] = []
+        self._rdzv = MasterRendezvousHandler(
+            RendezvousName.ELASTIC_TRAINING,
+            self._node_rank,
+            spec.nproc_per_node,
+            client=self._client,
+            timeout=spec.rdzv_timeout,
+        )
+        self._save_ckpt_hook = save_ckpt_hook
+        self._monitors = []
+        if start_monitors:
+            self._monitors = [
+                ResourceMonitor(client=self._client),
+                TrainingMonitor(
+                    TrainingMonitor.default_metrics_path(),
+                    client=self._client,
+                ),
+                HeartbeatReporter(client=self._client),
+            ]
+
+    # -- worker process management ----------------------------------------
+
+    def _worker_env(
+        self, outcome: RendezvousOutcome, local_rank: int
+    ) -> Dict[str, str]:
+        base_rank = outcome.base_rank(self._node_rank)
+        env = dict(os.environ)
+        env.update(self._spec.env)
+        env.update(
+            {
+                NodeEnv.COORDINATOR_ADDR: outcome.coordinator,
+                NodeEnv.PROCESS_ID: str(base_rank + local_rank),
+                NodeEnv.NUM_PROCESSES: str(outcome.world_size),
+                NodeEnv.LOCAL_RANK: str(local_rank),
+                NodeEnv.LOCAL_WORLD_SIZE: str(self._spec.nproc_per_node),
+                NodeEnv.RANK: str(base_rank + local_rank),
+                NodeEnv.WORLD_SIZE: str(outcome.world_size),
+                NodeEnv.NODE_RANK: str(self._node_rank),
+                NodeEnv.NODE_NUM: str(outcome.num_nodes),
+                NodeEnv.RESTART_COUNT: str(self._restart_count),
+                NodeEnv.MASTER_ADDR: self._client.master_addr,
+            }
+        )
+        return env
+
+    def _start_workers(self, outcome: RendezvousOutcome):
+        self._procs = []
+        for local_rank in range(self._spec.nproc_per_node):
+            env = self._worker_env(outcome, local_rank)
+            proc = subprocess.Popen(  # noqa: S603 - user entrypoint
+                self._spec.entrypoint, env=env
+            )
+            self._procs.append(proc)
+        logger.info(
+            "started %s worker process(es): %s",
+            len(self._procs), self._spec.entrypoint,
+        )
+
+    def _stop_workers(self, timeout: float = 30.0):
+        for p in self._procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + timeout
+        for p in self._procs:
+            remaining = max(0.1, deadline - time.time())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        self._procs = []
+
+    def _monitor_workers(self) -> Tuple[WorkerState, Dict[int, int]]:
+        """One poll of worker liveness -> (state, {local_rank: code})."""
+        codes: Dict[int, int] = {}
+        for local_rank, p in enumerate(self._procs):
+            rc = p.poll()
+            if rc is not None:
+                codes[local_rank] = rc
+        if not codes:
+            return WorkerState.HEALTHY, codes
+        if all(c == 0 for c in codes.values()) and len(codes) == len(
+            self._procs
+        ):
+            return WorkerState.SUCCEEDED, codes
+        if any(c != 0 for c in codes.values()):
+            return WorkerState.FAILED, codes
+        return WorkerState.HEALTHY, codes  # some exited 0, rest running
+
+    def _membership_changed(self) -> bool:
+        """True when the master has nodes waiting to join/leave and the
+        world should be re-formed (reference: training.py:711)."""
+        try:
+            waiting = self._client.num_nodes_waiting(
+                RendezvousName.ELASTIC_TRAINING
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("num_nodes_waiting failed: %s", e)
+            return False
+        if waiting == 0:
+            return False
+        # node_unit rounding: only restart when a full unit can join.
+        return waiting % self._spec.node_unit == 0 or waiting < 0
+
+    def _save_ckpt_at_breakpoint(self):
+        if self._save_ckpt_hook is not None:
+            try:
+                self._save_ckpt_hook()
+            except Exception as e:  # noqa: BLE001
+                logger.error("breakpoint checkpoint save failed: %s", e)
+
+    # -- health check -------------------------------------------------------
+
+    def node_health_check(self) -> bool:
+        """Run the network-check rendezvous rounds; raise if this node
+        is diagnosed faulty (reference: node_health_check,
+        training.py:1073)."""
+        for round_id in range(NetworkCheckConstant.MAX_CHECK_ROUNDS):
+            handler = MasterRendezvousHandler(
+                RendezvousName.NETWORK_CHECK,
+                self._node_rank,
+                self._spec.nproc_per_node,
+                client=self._client,
+                timeout=NetworkCheckConstant.CHECK_TIMEOUT,
+            )
+            outcome = handler.next_rendezvous()
+            normal, elapsed = True, 0.0
+            try:
+                elapsed = run_node_check(
+                    client=self._client,
+                    world_size=outcome.num_nodes,
+                    round_id=outcome.round,
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.error("node check failed: %s", e)
+                normal = False
+            self._client.report_network_status(
+                self._node_rank, normal, elapsed
+            )
+            result = self._client.check_fault_node()
+            if self._node_rank in result.fault_nodes:
+                raise RuntimeError(
+                    f"node {self._node_rank} diagnosed faulty: "
+                    f"{result.reason}"
+                )
+            if result.normal:
+                return True
+        return True
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self) -> int:
+        for m in self._monitors:
+            m.start()
+        try:
+            return self._invoke_run()
+        finally:
+            for m in self._monitors:
+                m.stop()
+
+    def _initialize_workers(self):
+        if self._spec.network_check:
+            self.node_health_check()
+        outcome = self._rdzv.next_rendezvous()
+        self._start_workers(outcome)
+
+    def _restart_workers(self):
+        self._restart_count += 1
+        logger.info("restarting workers (restart %s)", self._restart_count)
+        self._save_ckpt_at_breakpoint()
+        self._stop_workers()
+        self._initialize_workers()
+
+    def _invoke_run(self) -> int:
+        """Reference: _invoke_run (training.py:580)."""
+        self._initialize_workers()
+        while True:
+            time.sleep(self._spec.monitor_interval)
+            state, codes = self._monitor_workers()
+            if state == WorkerState.SUCCEEDED:
+                logger.info("all workers finished successfully")
+                self._client.ready_to_exit("succeeded")
+                return 0
+            if state == WorkerState.FAILED:
+                failed = {r: c for r, c in codes.items() if c != 0}
+                logger.error("worker failure(s): %s", failed)
+                self._client.report_failure(
+                    error_data=f"exitcodes={failed}",
+                    level=TrainingExceptionLevel.PROCESS_ERROR,
+                    restart_count=self._restart_count,
+                    node_rank=self._node_rank,
+                )
+                if self._restart_count >= self._spec.max_restarts:
+                    logger.error(
+                        "max restarts (%s) exhausted; giving up",
+                        self._spec.max_restarts,
+                    )
+                    self._save_ckpt_at_breakpoint()
+                    self._stop_workers()
+                    self._client.ready_to_exit("failed")
+                    return 1
+                self._restart_workers()
+            elif self._membership_changed():
+                logger.info("membership changed; re-rendezvous")
+                self._restart_workers()
+
+    def stop(self):
+        self._stop_workers()
+
+
+def launch_agent(
+    spec: WorkerSpec,
+    client: Optional[MasterClient] = None,
+    save_ckpt_hook: Optional[Callable[[], None]] = None,
+) -> int:
+    """Build and run the agent (reference: launch_agent, training.py:734)."""
+    agent = ElasticTrainingAgent(
+        spec, client=client, save_ckpt_hook=save_ckpt_hook
+    )
+    return agent.run()

@@ -1,0 +1,15 @@
+"""Flash checkpoint: sub-second in-memory snapshots of JAX pytrees with
+asynchronous persistence from the agent process (reference:
+``dlrover/python/elastic_agent/torch/ckpt_saver.py`` +
+``dlrover/trainer/torch/flash_checkpoint/``)."""
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+__all__ = [
+    "AsyncCheckpointSaver",
+    "CheckpointEngine",
+    "Checkpointer",
+    "StorageType",
+]

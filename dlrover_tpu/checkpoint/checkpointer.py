@@ -1,0 +1,63 @@
+"""User-facing flash-checkpoint API.
+
+Reference: ``Checkpointer`` ABC + ``DdpCheckpointer``
+(``dlrover/trainer/torch/flash_checkpoint/checkpointer.py:23``,
+``ddp.py:25``).  One class covers the JAX cases: replicated pytrees
+(DDP parity) and per-process-sharded pytrees (FSDP/GSPMD parity) —
+the sharding story is a constructor flag, not a separate engine
+hierarchy, because on TPU both are just pytrees of ``jax.Array``.
+"""
+
+from enum import Enum
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+
+class StorageType(Enum):
+    MEMORY = 0
+    DISK = 1
+
+
+class Checkpointer:
+    """Save/load JAX pytree checkpoints with sub-second step stall.
+
+    Usage::
+
+        ckpt = Checkpointer("/ckpt/dir")
+        ckpt.save_checkpoint(step, {"params": params, "opt": opt_state},
+                             storage_type=StorageType.DISK)
+        step, state = ckpt.load_checkpoint()
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        replicated: bool = True,
+        deletion_keep_latest: int = 0,
+        **engine_kwargs,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self._engine = CheckpointEngine(
+            checkpoint_dir,
+            replicated=replicated,
+            deletion_keep_latest=deletion_keep_latest,
+            **engine_kwargs,
+        )
+
+    def save_checkpoint(
+        self,
+        step: int,
+        state_dict: Any,
+        path: str = "",
+        storage_type: StorageType = StorageType.DISK,
+    ) -> bool:
+        if storage_type == StorageType.MEMORY:
+            return self._engine.save_to_memory(step, state_dict, path)
+        return self._engine.save_to_storage(step, state_dict, path)
+
+    def load_checkpoint(self) -> Tuple[Optional[int], Any]:
+        return self._engine.load()
+
+    def close(self):
+        self._engine.close()

@@ -1,0 +1,208 @@
+"""Trainer-process checkpoint engine: shm write + async persist enqueue.
+
+Reference: ``CheckpointEngine`` / ``FullCheckpointEngine``
+(``dlrover/trainer/torch/flash_checkpoint/engine.py:135,291``,
+``full_ckpt_engine.py``): ``save_to_memory`` copies the state dict
+into agent-owned shared memory under the shm lock (sub-second,
+blocking the train step only for the device->host copy);
+``save_to_storage`` additionally enqueues a SAVE event the agent
+persists asynchronously; ``load`` prefers the shm snapshot (process
+restart with agent alive) and falls back to storage.
+"""
+
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from dlrover_tpu.checkpoint.saver import (
+    EVENT_QUEUE,
+    FACTORY_QUEUE,
+    LOCK_PREFIX,
+    CheckpointEvent,
+    CheckpointEventType,
+    SaverConfig,
+    read_last_checkpoint,
+)
+from dlrover_tpu.checkpoint.shm_handler import (
+    CheckpointConfig,
+    SharedMemoryHandler,
+    state_dict_from_raw,
+)
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.storage import PosixDiskStorage
+
+
+class CheckpointEngine:
+    """Base engine: one per training process.
+
+    ``replicated=True`` (DDP-style full checkpoint): every rank writes
+    shm for fast restart-restore, only global rank 0's shard is
+    persisted (global_shard_num=1).  ``replicated=False``
+    (FSDP/GSPMD-style): every process persists its addressable shard
+    (global_shard_num=world_size).
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        replicated: bool = True,
+        local_rank: Optional[int] = None,
+        global_rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        deletion_keep_latest: int = 0,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.replicated = replicated
+        self._local_rank = (
+            local_rank if local_rank is not None
+            else env_utils.get_local_rank()
+        )
+        self._rank = (
+            global_rank if global_rank is not None else env_utils.get_rank()
+        )
+        self._world_size = (
+            world_size if world_size is not None
+            else env_utils.get_world_size()
+        )
+        self._shm_handler = SharedMemoryHandler(self._local_rank, host=False)
+        self._shm_lock = SharedLock(
+            f"{LOCK_PREFIX}_{self._local_rank}", create=False
+        )
+        self._event_queue = (
+            SharedQueue(EVENT_QUEUE, create=False)
+            if self._rank == 0 else None
+        )
+        self._storage = PosixDiskStorage()
+        self._notified_agent = False
+        self._deletion_keep_latest = deletion_keep_latest
+        self._cached_step = -1
+
+    @property
+    def global_shard_num(self) -> int:
+        return 1 if self.replicated else self._world_size
+
+    def _notify_agent_to_create_saver(self):
+        """Ship the saver config to the agent's factory queue once
+        (reference: engine.py:253)."""
+        if self._notified_agent or self._local_rank != 0:
+            self._notified_agent = True
+            return
+        from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+        from dlrover_tpu.common.multi_process import _socket_path
+
+        if AsyncCheckpointSaver.get_ckpt_saver() is not None:
+            # saver already exists in this process (tests / local mode)
+            self._notified_agent = True
+            return
+        if not os.path.exists(_socket_path(FACTORY_QUEUE)):
+            logger.warning(
+                "no agent checkpoint-saver factory found; shm snapshots "
+                "will not be persisted asynchronously"
+            )
+            self._notified_agent = True
+            return
+        factory = SharedQueue(FACTORY_QUEUE, create=False)
+        factory.put(
+            SaverConfig(
+                checkpoint_dir=self.checkpoint_dir,
+                local_shard_num=env_utils.get_local_world_size(),
+                global_shard_num=self.global_shard_num,
+                node_rank=env_utils.get_node_rank(),
+                deletion_keep_latest=self._deletion_keep_latest,
+            )
+        )
+        self._notified_agent = True
+
+    # -- save ---------------------------------------------------------------
+
+    def save_to_memory(self, step: int, state_dict, path: str = "") -> bool:
+        """Synchronous part of a flash save: device->host copy into
+        shm under the shm lock.  Non-blocking lock: if the agent is
+        still persisting the previous snapshot, skip this save rather
+        than stall training (reference: save_state_dict_to_memory,
+        engine.py:291)."""
+        self._notify_agent_to_create_saver()
+        if self._shard_should_persist():
+            if not self._shm_lock.acquire(blocking=False):
+                logger.info(
+                    "step %s: saver busy persisting; skipping shm save",
+                    step,
+                )
+                return False
+        try:
+            config = CheckpointConfig(
+                step=step,
+                path=path or self.checkpoint_dir,
+                rank=self._rank,
+                world_size=self._world_size,
+                global_shard_num=self.global_shard_num,
+            )
+            start = time.time()
+            self._shm_handler.save_state_dict(state_dict, config)
+            self._cached_step = step
+            logger.info(
+                "rank %s shm save of step %s took %.3fs",
+                self._rank, step, time.time() - start,
+            )
+            return True
+        finally:
+            if self._shard_should_persist():
+                self._shm_lock.release()
+
+    def _shard_should_persist(self) -> bool:
+        """Whether this process's shard participates in storage
+        persistence (rank 0 only for replicated checkpoints)."""
+        return not self.replicated or self._rank == 0
+
+    def save_to_storage(self, step: int, state_dict, path: str = "") -> bool:
+        """Flash save: shm write now, async persist by the agent
+        (reference: save_to_storage in full_ckpt_engine.py)."""
+        ok = self.save_to_memory(step, state_dict, path)
+        if ok and self._event_queue is not None:
+            self._event_queue.put(
+                CheckpointEvent(
+                    event_type=CheckpointEventType.SAVE, step=step
+                )
+            )
+        return ok
+
+    # -- load ---------------------------------------------------------------
+
+    def load(self) -> Tuple[Optional[int], Any]:
+        """Restore: shm snapshot if present (fast path after process
+        restart), else storage via the tracker file."""
+        config, state = self.get_state_dict_from_memory()
+        if config is not None:
+            logger.info("restored step %s from shared memory", config.step)
+            return config.step, state
+        return self.load_from_storage()
+
+    def get_state_dict_from_memory(self):
+        try:
+            return self._shm_handler.load_state_dict()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("shm restore failed: %s", e)
+            return None, {}
+
+    def load_from_storage(self) -> Tuple[Optional[int], Any]:
+        step, shards = read_last_checkpoint(
+            self.checkpoint_dir, self._storage
+        )
+        if step is None:
+            return None, {}
+        want_rank = 0 if self.replicated else self._rank
+        if want_rank not in shards:
+            logger.error(
+                "checkpoint step %s has no shard for rank %s "
+                "(topology changed? shards=%s)",
+                step, want_rank, sorted(shards),
+            )
+            return None, {}
+        meta, raw = shards[want_rank]
+        logger.info("restored step %s from storage", step)
+        return step, state_dict_from_raw(meta, raw)
+
+    def close(self):
+        self._shm_handler.close()

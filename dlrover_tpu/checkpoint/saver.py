@@ -1,0 +1,383 @@
+"""Agent-process asynchronous checkpoint saver.
+
+Reference: ``AsyncCheckpointSaver``
+(``dlrover/python/elastic_agent/torch/ckpt_saver.py:344``): a factory
+thread in the *agent* process waits for the trainer to ship a saver
+config, then an event loop persists shared-memory snapshots to storage
+— so a checkpoint written to shm survives a crashed trainer and is
+still persisted.  Commit protocol: per-shard done files polled by the
+lead agent, then an atomic tracker-file update
+(``commit_checkpoint:860``, ``update_tracker_file:783``).  Signal
+handlers persist the shm snapshot on SIGTERM
+(``register_signal_handler:472``).
+"""
+
+import os
+import pickle
+import queue
+import signal
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.checkpoint.shm_handler import (
+    CheckpointConfig,
+    SharedMemoryHandler,
+)
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.storage import (
+    CheckpointStorage,
+    PosixDiskStorage,
+    get_checkpoint_storage,
+)
+
+FACTORY_QUEUE = "ckpt_factory"
+EVENT_QUEUE = "ckpt_event_queue"
+LOCK_PREFIX = "ckpt_lock"
+
+
+class CheckpointEventType:
+    SAVE = "save"
+    UPDATE_SHARD = "update_shard"
+    EXIT = "exit"
+
+
+@dataclass
+class CheckpointEvent:
+    event_type: str = CheckpointEventType.SAVE
+    step: int = 0
+    global_shard_num: int = 1
+
+
+@dataclass
+class SaverConfig:
+    """Shipped from trainer to agent on first save (reference:
+    ``ClassMeta`` on SharedQueue("factory"), engine.py:253)."""
+
+    checkpoint_dir: str = ""
+    local_shard_num: int = 1
+    global_shard_num: int = 1
+    node_rank: int = 0
+    storage_type: str = "posix"
+    deletion_keep_latest: int = 0
+    extra: Dict = field(default_factory=dict)
+
+
+def shard_file(rank: int) -> str:
+    return f"rank_{rank}.ckpt"
+
+
+def meta_file(rank: int) -> str:
+    return f"rank_{rank}.meta"
+
+
+def step_dirname(step: int) -> str:
+    return f"{CheckpointConstant.CKPT_NAME_PREFIX}{step}"
+
+
+class AsyncCheckpointSaver:
+    """One instance per agent; class-level singleton + factory thread."""
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+    _factory_thread: Optional[threading.Thread] = None
+    _factory_queue: Optional[SharedQueue] = None
+    _lock = threading.Lock()
+
+    def __init__(self, config: SaverConfig,
+                 storage: Optional[CheckpointStorage] = None):
+        self.config = config
+        self.storage = storage or get_checkpoint_storage(config.storage_type)
+        self._shm_handlers = [
+            SharedMemoryHandler(r, host=True)
+            for r in range(config.local_shard_num)
+        ]
+        self._shm_locks = [
+            SharedLock(f"{LOCK_PREFIX}_{r}", create=True)
+            for r in range(config.local_shard_num)
+        ]
+        self._event_queue = SharedQueue(EVENT_QUEUE, create=True)
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(4, config.local_shard_num),
+            thread_name_prefix="ckpt-persist",
+        )
+        self._stopped = threading.Event()
+        self._last_persisted_step = -1
+        self._event_thread = threading.Thread(
+            target=self._sync_shm_to_storage, daemon=True,
+            name="ckpt-event-loop",
+        )
+        self._event_thread.start()
+
+    # -- class-level lifecycle (agent entry) -------------------------------
+
+    @classmethod
+    def start_async_saving_ckpt(cls):
+        """Start the factory thread that waits for a trainer's saver
+        config (reference: start_async_saving_ckpt, ckpt_saver.py:410)."""
+        with cls._lock:
+            if cls._factory_thread is not None:
+                return
+            cls._factory_queue = SharedQueue(FACTORY_QUEUE, create=True)
+            cls._factory_thread = threading.Thread(
+                target=cls._factory_loop, daemon=True, name="ckpt-factory"
+            )
+            cls._factory_thread.start()
+
+    @classmethod
+    def _factory_loop(cls):
+        while True:
+            try:
+                config = cls._factory_queue.get(timeout=3600.0)
+            except queue.Empty:
+                continue
+            except Exception:  # queue server closed
+                return
+            if config is None:
+                return
+            with cls._lock:
+                if cls._instance is None:
+                    logger.info("creating checkpoint saver: %s", config)
+                    cls._instance = cls(config)
+                else:
+                    cls._instance.config = config
+
+    @classmethod
+    def get_ckpt_saver(cls) -> Optional["AsyncCheckpointSaver"]:
+        return cls._instance
+
+    @classmethod
+    def save_shm_to_storage(cls):
+        """Persist whatever snapshot is in shm (breakpoint save before
+        an agent-driven restart or on SIGTERM; reference:
+        save_shm_to_storage, ckpt_saver.py:633)."""
+        saver = cls._instance
+        if saver is None:
+            return
+        steps = [
+            cfg.step
+            for cfg in (
+                h.get_checkpoint_config() for h in saver._shm_handlers
+            )
+            if cfg is not None and not cfg.writing
+        ]
+        if not steps:
+            return
+        step = min(steps)
+        if step > saver._last_persisted_step:
+            logger.info("breakpoint-saving shm checkpoint step %s", step)
+            saver.save_step_checkpoint(step)
+
+    @classmethod
+    def register_signal_handler(cls):
+        """SIGTERM -> persist shm then re-raise default behaviour
+        (reference: register_signal_handler, ckpt_saver.py:472)."""
+
+        def _on_term(signum, frame):
+            cls.save_shm_to_storage()
+            os._exit(143)
+
+        signal.signal(signal.SIGTERM, _on_term)
+
+    @classmethod
+    def stop_all(cls):
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+                cls._instance = None
+            if cls._factory_queue is not None:
+                cls._factory_queue.close()
+                cls._factory_queue = None
+            cls._factory_thread = None
+
+    @classmethod
+    def reset(cls):
+        """Test helper: tear down singletons."""
+        cls.stop_all()
+
+    # -- event loop ---------------------------------------------------------
+
+    def _sync_shm_to_storage(self):
+        """Reference: _sync_shm_to_storage loop, ckpt_saver.py:517."""
+        while not self._stopped.is_set():
+            try:
+                event: CheckpointEvent = self._event_queue.get(timeout=2.0)
+            except queue.Empty:
+                continue
+            except Exception:
+                return
+            if event.event_type == CheckpointEventType.EXIT:
+                return
+            if event.event_type == CheckpointEventType.UPDATE_SHARD:
+                self.config.global_shard_num = event.global_shard_num
+                continue
+            if event.event_type == CheckpointEventType.SAVE:
+                try:
+                    self.save_step_checkpoint(event.step)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "persisting checkpoint step %s failed", event.step
+                    )
+
+    # -- persist -----------------------------------------------------------
+
+    def save_step_checkpoint(self, step: int):
+        """Persist every local shard of ``step`` then commit
+        (reference: save_step_checkpoint, ckpt_saver.py:795)."""
+        start = time.time()
+        step_dir = os.path.join(
+            self.config.checkpoint_dir, step_dirname(step)
+        )
+        self.storage.safe_makedirs(step_dir)
+        futures = []
+        for local_rank, handler in enumerate(self._shm_handlers):
+            futures.append(
+                self._executor.submit(
+                    self._save_shard, step, local_rank, handler, step_dir
+                )
+            )
+        ok = all(f.result() for f in futures)
+        if not ok:
+            logger.error("step %s: some shards failed to persist", step)
+            return
+        if self.config.node_rank == 0:
+            self.commit_checkpoint(step, step_dir)
+        self._last_persisted_step = step
+        logger.info(
+            "persisted checkpoint step %s in %.2fs", step,
+            time.time() - start,
+        )
+
+    def _save_shard(
+        self, step: int, local_rank: int,
+        handler: SharedMemoryHandler, step_dir: str,
+    ) -> bool:
+        """One shard shm -> storage under the shard's shm lock so the
+        trainer cannot overwrite mid-persist (reference: _save_shard +
+        the lock protocol, ckpt_saver.py:558-574)."""
+        lock = self._shm_locks[local_rank]
+        acquired = lock.acquire(timeout=60.0)
+        try:
+            config, raw, meta = handler.read_raw()
+            if config is None:
+                logger.warning(
+                    "rank %s has no shm snapshot for step %s",
+                    local_rank, step,
+                )
+                return False
+            if config.step != step:
+                logger.warning(
+                    "rank %s shm holds step %s, wanted %s; persisting "
+                    "what is there", local_rank, config.step, step,
+                )
+            global_rank = config.rank
+            self.storage.write(
+                raw, os.path.join(step_dir, shard_file(global_rank))
+            )
+            self.storage.write(
+                pickle.dumps(meta),
+                os.path.join(step_dir, meta_file(global_rank)),
+            )
+            # done file marks this shard committed
+            self.storage.write(
+                b"", os.path.join(
+                    step_dir,
+                    f"{CheckpointConstant.DONE_FILE_PREFIX}{global_rank}",
+                ),
+            )
+            return True
+        finally:
+            if acquired:
+                lock.release(force=True)
+
+    def commit_checkpoint(
+        self, step: int, step_dir: str,
+        timeout: float = CheckpointConstant.SAVE_TIMEOUT,
+    ):
+        """Poll done files == global_shard_num then atomically update
+        the tracker file (reference: commit_checkpoint,
+        ckpt_saver.py:860)."""
+        deadline = time.time() + timeout
+        expected = self.config.global_shard_num
+        while time.time() < deadline:
+            try:
+                done = [
+                    f for f in self.storage.listdir(step_dir)
+                    if f.startswith(CheckpointConstant.DONE_FILE_PREFIX)
+                ]
+            except FileNotFoundError:
+                done = []
+            if len(done) >= expected:
+                tracker = os.path.join(
+                    self.config.checkpoint_dir,
+                    CheckpointConstant.TRACKER_FILE,
+                )
+                self.storage.write(str(step), tracker)
+                self.storage.commit(step, True)
+                self._clean_old_checkpoints(step)
+                return
+            time.sleep(0.5)
+        logger.error(
+            "commit of step %s timed out (%s/%s done files)",
+            step, len(done), expected,
+        )
+
+    def _clean_old_checkpoints(self, current_step: int):
+        keep = self.config.deletion_keep_latest
+        if keep <= 0:
+            return
+        root = self.config.checkpoint_dir
+        try:
+            steps = sorted(
+                int(d[len(CheckpointConstant.CKPT_NAME_PREFIX):])
+                for d in self.storage.listdir(root)
+                if d.startswith(CheckpointConstant.CKPT_NAME_PREFIX)
+                and d[len(CheckpointConstant.CKPT_NAME_PREFIX):].isdigit()
+            )
+        except FileNotFoundError:
+            return
+        for s in steps[:-keep]:
+            self.storage.safe_rmtree(os.path.join(root, step_dirname(s)))
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._event_queue.put(
+                CheckpointEvent(event_type=CheckpointEventType.EXIT)
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        self._executor.shutdown(wait=False)
+        for h in self._shm_handlers:
+            h.close()
+        for lk in self._shm_locks:
+            lk.close()
+        self._event_queue.close()
+
+
+def read_last_checkpoint(
+    checkpoint_dir: str, storage: Optional[CheckpointStorage] = None,
+):
+    """Storage-side load: tracker file -> per-rank shard dict
+    (reference: the load fallback in engine.py:325 when shm misses).
+    Returns (step, {global_rank: (meta, raw_bytes)}) or (None, {}).
+    """
+    storage = storage or PosixDiskStorage()
+    tracker = os.path.join(checkpoint_dir, CheckpointConstant.TRACKER_FILE)
+    if not storage.exists(tracker):
+        return None, {}
+    step = int(str(storage.read(tracker, mode="r")).strip())
+    step_dir = os.path.join(checkpoint_dir, step_dirname(step))
+    shards: Dict[int, tuple] = {}
+    for fname in storage.listdir(step_dir):
+        if fname.startswith("rank_") and fname.endswith(".ckpt"):
+            rank = int(fname[len("rank_"):-len(".ckpt")])
+            raw = storage.read(os.path.join(step_dir, fname))
+            meta = pickle.loads(
+                storage.read(os.path.join(step_dir, meta_file(rank)))
+            )
+            shards[rank] = (meta, raw)
+    return step, shards

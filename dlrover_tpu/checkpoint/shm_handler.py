@@ -1,0 +1,284 @@
+"""Pytree <-> shared-memory serialization.
+
+Reference: ``SharedMemoryHandler`` / ``TensorMeta``
+(``dlrover/python/elastic_agent/torch/ckpt_saver.py:65,209``): a state
+dict is traversed into one flat shared-memory buffer plus a meta dict
+(shape/dtype/offset per leaf) published through a ``SharedDict``; the
+agent process re-materializes tensors zero-copy with ``frombuffer``.
+
+The JAX version traverses a pytree with ``jax.tree_util`` key paths.
+Array leaves (jax/numpy) are device_get into the shm buffer — for a
+sharded ``jax.Array`` only this host's addressable shards would be
+copied by the sharded engine; this handler takes whatever ``np.asarray``
+of the leaf yields.  Non-array leaves (step counters, strings, opt
+hyperparams) are pickled into a trailing blob.
+"""
+
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import (
+    PersistentSharedMemory,
+    SharedDict,
+    get_or_create_shm,
+)
+
+
+@dataclass
+class TensorMeta:
+    """Placement of one array leaf inside the flat buffer
+    (reference: ckpt_saver.py:65)."""
+
+    shape: Tuple[int, ...] = ()
+    dtype: str = "float32"
+    offset: int = 0
+    nbytes: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Per-snapshot metadata carried with the shm segment
+    (reference: ckpt_saver.py:74)."""
+
+    step: int = 0
+    path: str = ""
+    rank: int = 0
+    world_size: int = 1
+    # shards expected globally for the commit protocol
+    global_shard_num: int = 1
+    writing: bool = False
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def _flatten_state_dict(state_dict) -> Dict[str, Any]:
+    """Pytree -> {"a/b/0": leaf} using jax key paths."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(state_dict)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(_path_str(p) for p in path)
+        out[key] = leaf
+    return out
+
+
+def _path_str(entry) -> str:
+    import jax
+
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, jax.tree_util.GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, jax.tree_util.FlattenedIndexKey):
+        return str(entry.key)
+    return str(entry)
+
+
+def _unflatten_to_nested(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """{"a/b": v} -> {"a": {"b": v}}; integer-keyed dicts stay dicts
+    (exact container types are the engine caller's concern — the state
+    dict contract is string/index-keyed nesting, like the reference's
+    torch state dicts)."""
+    root: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return root
+
+
+class SharedMemoryHandler:
+    """Owns one shm segment + meta SharedDict for one local rank."""
+
+    SHM_PREFIX = "dlrover_tpu_ckpt_shm"
+    META_PREFIX = "ckpt_meta"
+
+    def __init__(self, local_rank: int, host: bool = False,
+                 job_name: str = ""):
+        self._rank = local_rank
+        suffix = f"{job_name}_{local_rank}" if job_name else str(local_rank)
+        self._shm_name = f"{self.SHM_PREFIX}_{suffix}"
+        self._meta = SharedDict(
+            f"{self.META_PREFIX}_{suffix}", create=host
+        )
+        self._shm: Optional[PersistentSharedMemory] = None
+        self._write_lock = threading.Lock()
+
+    # -- write (trainer side) ---------------------------------------------
+
+    def save_state_dict(self, state_dict, config: CheckpointConfig):
+        """Serialize the pytree into shm and publish the meta dict."""
+        flat = _flatten_state_dict(state_dict)
+        arrays: Dict[str, np.ndarray] = {}
+        scalars: Dict[str, Any] = {}
+        for key, leaf in flat.items():
+            arr = self._to_numpy(leaf)
+            if arr is not None:
+                arrays[key] = arr
+            else:
+                scalars[key] = leaf
+        scalar_blob = pickle.dumps(scalars)
+
+        metas: Dict[str, TensorMeta] = {}
+        offset = 0
+        for key, arr in arrays.items():
+            metas[key] = TensorMeta(
+                shape=tuple(arr.shape),
+                dtype=str(arr.dtype),
+                offset=offset,
+                nbytes=arr.nbytes,
+            )
+            offset += arr.nbytes
+        total = offset + len(scalar_blob)
+
+        with self._write_lock:
+            if self._shm is None or self._shm.size < total:
+                if self._shm is not None:
+                    self._shm.close()
+                    self._shm.unlink()
+                    self._shm = None
+                self._shm = get_or_create_shm(self._shm_name, total)
+            config.writing = True
+            self._publish_meta(metas, config, offset, len(scalar_blob))
+            buf = self._shm.buf
+            for key, arr in arrays.items():
+                m = metas[key]
+                buf[m.offset:m.offset + m.nbytes] = arr.tobytes()
+            buf[offset:offset + len(scalar_blob)] = scalar_blob
+            config.writing = False
+            self._publish_meta(metas, config, offset, len(scalar_blob))
+        logger.debug(
+            "rank %s wrote %.1f MB checkpoint step %s to shm",
+            self._rank, total / 2**20, config.step,
+        )
+
+    @staticmethod
+    def _to_numpy(leaf) -> Optional[np.ndarray]:
+        """Array leaf -> contiguous host ndarray; None for non-arrays.
+
+        For jax.Array this is the device->host copy — the synchronous
+        part of a flash save (reference: the GPU->CPU memcpy in
+        _traverse_copy_to_shm, ckpt_saver.py:174).
+        """
+        if isinstance(leaf, np.ndarray):
+            return np.ascontiguousarray(leaf)
+        # jax.Array without importing jax at module scope for the agent
+        if type(leaf).__module__.startswith(("jaxlib", "jax")):
+            return np.ascontiguousarray(np.asarray(leaf))
+        if isinstance(leaf, (np.generic,)):
+            return np.ascontiguousarray(np.asarray(leaf))
+        return None
+
+    def _publish_meta(
+        self, metas: Dict[str, TensorMeta], config: CheckpointConfig,
+        scalar_offset: int, scalar_nbytes: int,
+    ):
+        self._meta.set(
+            {
+                "tensors": metas,
+                "config": config,
+                "scalar_offset": scalar_offset,
+                "scalar_nbytes": scalar_nbytes,
+            }
+        )
+
+    # -- read (agent side / restore) --------------------------------------
+
+    def metadata(self) -> Dict[str, Any]:
+        return self._meta.get()
+
+    def get_checkpoint_config(self) -> Optional[CheckpointConfig]:
+        meta = self._meta.get()
+        return meta.get("config") if meta else None
+
+    def no_checkpoint_state(self) -> bool:
+        cfg = self.get_checkpoint_config()
+        return cfg is None or cfg.step <= 0
+
+    def _attach(self) -> Optional[PersistentSharedMemory]:
+        if self._shm is None:
+            try:
+                self._shm = PersistentSharedMemory(name=self._shm_name)
+            except FileNotFoundError:
+                return None
+        return self._shm
+
+    def load_state_dict(self) -> Tuple[Optional[CheckpointConfig], Any]:
+        """Zero-copy-read the shm snapshot back into a nested dict of
+        numpy arrays (caller device_puts with its shardings)."""
+        meta = self._meta.get()
+        if not meta:
+            return None, {}
+        config: CheckpointConfig = meta["config"]
+        if config.writing:
+            logger.warning("shm snapshot is mid-write; refusing to load")
+            return None, {}
+        shm = self._attach()
+        if shm is None:
+            return None, {}
+        flat: Dict[str, Any] = {}
+        for key, m in meta["tensors"].items():
+            arr = np.frombuffer(
+                shm.buf, dtype=np.dtype(m.dtype), count=int(
+                    np.prod(m.shape, dtype=np.int64)
+                ) if m.shape else 1, offset=m.offset,
+            ).reshape(m.shape)
+            flat[key] = arr.copy()  # detach from the buffer lifetime
+        blob = bytes(
+            shm.buf[
+                meta["scalar_offset"]:
+                meta["scalar_offset"] + meta["scalar_nbytes"]
+            ]
+        )
+        flat.update(pickle.loads(blob))
+        return config, _unflatten_to_nested(flat)
+
+    def read_raw(self) -> Tuple[Optional[CheckpointConfig], bytes, Dict]:
+        """Raw bytes + meta for the agent's persist path (no pytree
+        reconstruction, just shm -> storage streaming)."""
+        meta = self._meta.get()
+        if not meta:
+            return None, b"", {}
+        config: CheckpointConfig = meta["config"]
+        shm = self._attach()
+        if shm is None or config.writing:
+            return None, b"", {}
+        total = meta["scalar_offset"] + meta["scalar_nbytes"]
+        return config, bytes(shm.buf[:total]), meta
+
+    def close(self):
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
+        self._meta.close()
+
+    def unlink(self):
+        if self._attach() is not None:
+            self._shm.unlink()
+            self._shm = None
+
+
+def state_dict_from_raw(meta: Dict, raw: bytes):
+    """Rebuild the nested dict from raw shm bytes (storage load path)."""
+    flat: Dict[str, Any] = {}
+    for key, m in meta["tensors"].items():
+        arr = np.frombuffer(
+            raw, dtype=np.dtype(m.dtype),
+            count=int(np.prod(m.shape, dtype=np.int64)) if m.shape else 1,
+            offset=m.offset,
+        ).reshape(m.shape)
+        flat[key] = arr.copy()
+    blob = raw[
+        meta["scalar_offset"]:meta["scalar_offset"] + meta["scalar_nbytes"]
+    ]
+    flat.update(pickle.loads(blob))
+    return _unflatten_to_nested(flat)

@@ -1,0 +1,157 @@
+"""``tpurun`` — the elastic launcher CLI.
+
+Reference: ``dlrover/trainer/torch/elastic_run.py`` (``dlrover-run``, a
+torchrun superset: parse_args:125, run:342,
+_launch_dlrover_local_master:237).  ``tpurun`` supervises one node's
+training processes: on node rank 0 with no external master it spawns a
+local master subprocess, then runs the elastic agent which joins the
+master rendezvous, exports the ``jax.distributed.initialize``
+coordinates and spawns/monitors the training script.
+
+Usage::
+
+    tpurun --nnodes=1:4 --nproc_per_node=1 --network-check train.py ...
+    # or
+    python -m dlrover_tpu.run train.py ...
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import WorkerSpec, launch_agent
+from dlrover_tpu.common.comm import addr_connected, find_free_port
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def parse_nnodes(value: str) -> Tuple[int, int]:
+    if ":" in value:
+        lo, hi = value.split(":")
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def parse_args(argv: Optional[List[str]] = None):
+    parser = argparse.ArgumentParser(
+        prog="tpurun", description="elastic TPU training launcher"
+    )
+    parser.add_argument(
+        "--nnodes", type=str, default="1",
+        help="number of nodes, or MIN:MAX for elastic jobs",
+    )
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=None)
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument(
+        "--node_unit", type=int, default=1,
+        help="world size changes in multiples of this many nodes",
+    )
+    parser.add_argument(
+        "--network-check", action="store_true", dest="network_check",
+        help="run chip/fabric health checks before training",
+    )
+    parser.add_argument(
+        "--master_addr", type=str, default="",
+        help="job master host:port; spawned locally if empty on rank 0",
+    )
+    parser.add_argument("--monitor_interval", type=float, default=2.0)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def _launch_local_master(max_nodes: int, port: int = 0) -> Tuple[
+    subprocess.Popen, str
+]:
+    """Spawn ``python -m dlrover_tpu.master.main`` for single-node /
+    test jobs (reference: _launch_dlrover_local_master,
+    elastic_run.py:237)."""
+    port = port or find_free_port()
+    proc = subprocess.Popen(  # noqa: S603
+        [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--port", str(port),
+            "--node_num", str(max_nodes),
+        ],
+        env=dict(os.environ),
+    )
+    addr = f"127.0.0.1:{port}"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if addr_connected(addr):
+            return proc, addr
+        if proc.poll() is not None:
+            raise RuntimeError("local master exited during startup")
+        time.sleep(0.3)
+    proc.kill()
+    raise RuntimeError("local master did not become reachable")
+
+
+def run(args) -> int:
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    node_rank = (
+        args.node_rank
+        if args.node_rank is not None
+        else int(os.getenv(NodeEnv.NODE_RANK, "0"))
+    )
+    master_addr = args.master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")
+    master_proc: Optional[subprocess.Popen] = None
+    if not master_addr:
+        if node_rank != 0:
+            raise RuntimeError(
+                "--master_addr (or DLROVER_MASTER_ADDR) is required on "
+                "non-zero node ranks"
+            )
+        master_proc, master_addr = _launch_local_master(max_nodes)
+        logger.info("launched local master at %s", master_addr)
+
+    os.environ[NodeEnv.MASTER_ADDR] = master_addr
+    os.environ.setdefault(NodeEnv.NODE_ID, str(node_rank))
+    os.environ.setdefault(NodeEnv.NODE_RANK, str(node_rank))
+    MasterClient.reset()
+
+    entrypoint = [sys.executable, args.training_script]
+    entrypoint += list(args.training_script_args)
+    spec = WorkerSpec(
+        entrypoint=entrypoint,
+        nproc_per_node=args.nproc_per_node,
+        max_restarts=args.max_restarts,
+        monitor_interval=args.monitor_interval,
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        node_unit=args.node_unit,
+        network_check=args.network_check,
+    )
+
+    # Breakpoint-checkpoint hook: persist any shm checkpoint before a
+    # restart (wired to the agent-side saver when one is registered).
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    saver_hook = AsyncCheckpointSaver.save_shm_to_storage
+    AsyncCheckpointSaver.start_async_saving_ckpt()
+
+    try:
+        return launch_agent(spec, save_ckpt_hook=saver_hook)
+    finally:
+        AsyncCheckpointSaver.stop_all()
+        if master_proc is not None:
+            master_proc.terminate()
+            try:
+                master_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master_proc.kill()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

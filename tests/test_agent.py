@@ -1,0 +1,183 @@
+"""Agent-layer tests against a real in-process master (the reference's
+local-master fixture pattern): master client RPCs, sharding client,
+rendezvous handler, worker supervision and restart, node check."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.node_check import bm_chip_matmul, mock_error
+from dlrover_tpu.agent.sharding_client import (
+    IndexShardingClient,
+    ShardingClient,
+)
+from dlrover_tpu.agent.training import (
+    ElasticTrainingAgent,
+    MasterRendezvousHandler,
+    WorkerSpec,
+)
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.master.master import JobMaster
+
+
+@pytest.fixture()
+def master():
+    m = JobMaster(port=0, node_num=1, job_name="agent-test")
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(f"127.0.0.1:{master.port}", node_id=0,
+                     node_type="worker")
+    yield c
+    c.close()
+
+
+def test_kv_store_roundtrip(client):
+    client.kv_store_set("k", b"v1")
+    assert client.kv_store_get("k") == b"v1"
+    assert client.kv_store_add("ctr", 2) == 2
+    assert client.kv_store_add("ctr", 3) == 5
+
+
+def test_rendezvous_handler_single_node(client):
+    handler = MasterRendezvousHandler(
+        RendezvousName.ELASTIC_TRAINING, node_rank=0, local_world_size=2,
+        client=client, timeout=30,
+    )
+    out = handler.next_rendezvous()
+    assert out.world == {0: 2}
+    assert out.world_size == 2
+    assert out.base_rank(0) == 0
+    assert out.coordinator
+
+
+def test_heartbeat_and_metrics(client):
+    assert client.report_heartbeat() == ""
+    client.report_global_step(10)
+    client.report_resource_stats(12.0, 1024.0)
+    client.report_model_info(125_000_000, "bfloat16")
+
+
+def test_sharding_client_consumes_dataset(client):
+    sc = ShardingClient(
+        dataset_name="ds1", batch_size=4, num_epochs=1, dataset_size=32,
+        master_client=client, num_minibatches_per_shard=2,
+    )
+    seen = 0
+    while True:
+        task = sc.fetch_task()
+        if task is None:
+            break
+        seen += task.shard_size
+        sc.report_task_done(task.task_id)
+    assert seen == 32
+
+
+def test_index_sharding_client_stream(client):
+    isc = IndexShardingClient(
+        dataset_name="ds2", batch_size=4, num_epochs=1, dataset_size=16,
+        master_client=client,
+    )
+    indices = []
+    while True:
+        idx = isc.fetch_sample_index(timeout=30)
+        if idx is None:
+            break
+        indices.append(idx)
+        if len(indices) % 4 == 0:
+            isc.report_batch_done()
+    assert sorted(indices) == list(range(16))
+    isc.stop()
+
+
+def test_dataset_checkpoint_roundtrip(client):
+    sc = ShardingClient(
+        dataset_name="ds3", batch_size=2, num_epochs=1, dataset_size=8,
+        master_client=client,
+    )
+    sc.fetch_task()
+    content = sc.get_checkpoint()
+    assert content
+    sc.restore_checkpoint(content)
+
+
+def test_mock_error_fault_injection(monkeypatch):
+    monkeypatch.setenv(NodeEnv.MOCK_ERR_RANK, "0")
+    monkeypatch.setenv(NodeEnv.NODE_RANK, "0")
+    with pytest.raises(RuntimeError):
+        mock_error()
+    monkeypatch.setenv(NodeEnv.NODE_RANK, "1")
+    mock_error()  # other ranks pass
+
+
+def test_chip_matmul_benchmark():
+    elapsed = bm_chip_matmul(size=64, rounds=2)
+    assert elapsed > 0
+
+
+def _worker_script(tmp_path, body: str) -> str:
+    path = os.path.join(tmp_path, "worker.py")
+    with open(path, "w") as f:
+        f.write(body)
+    return path
+
+
+def test_agent_runs_worker_to_success(master, client, tmp_path):
+    script = _worker_script(
+        str(tmp_path),
+        "import os\n"
+        "assert os.environ['DLROVER_COORDINATOR_ADDR']\n"
+        "assert os.environ['DLROVER_RANK'] == '0'\n"
+        "assert os.environ['DLROVER_WORLD_SIZE'] == '1'\n",
+    )
+    spec = WorkerSpec(
+        entrypoint=[sys.executable, script],
+        nproc_per_node=1, max_restarts=1, monitor_interval=0.2,
+    )
+    agent = ElasticTrainingAgent(
+        spec, client=client, node_rank=0, start_monitors=False
+    )
+    assert agent.run() == 0
+
+
+def test_agent_restarts_then_fails(master, client, tmp_path):
+    script = _worker_script(str(tmp_path), "import sys; sys.exit(3)\n")
+    spec = WorkerSpec(
+        entrypoint=[sys.executable, script],
+        nproc_per_node=1, max_restarts=1, monitor_interval=0.2,
+    )
+    hook_calls = []
+    agent = ElasticTrainingAgent(
+        spec, client=client, node_rank=0, start_monitors=False,
+        save_ckpt_hook=lambda: hook_calls.append(1),
+    )
+    assert agent.run() == 1
+    # breakpoint-save hook fired on restart and on final failure
+    assert len(hook_calls) >= 1
+
+
+def test_agent_worker_succeeds_after_one_restart(master, client, tmp_path):
+    flag = os.path.join(str(tmp_path), "flag")
+    script = _worker_script(
+        str(tmp_path),
+        "import os, sys\n"
+        f"flag = {flag!r}\n"
+        "if not os.path.exists(flag):\n"
+        "    open(flag, 'w').close()\n"
+        "    sys.exit(5)\n",
+    )
+    spec = WorkerSpec(
+        entrypoint=[sys.executable, script],
+        nproc_per_node=1, max_restarts=2, monitor_interval=0.2,
+    )
+    agent = ElasticTrainingAgent(
+        spec, client=client, node_rank=0, start_monitors=False
+    )
+    assert agent.run() == 0
