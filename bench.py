@@ -1,0 +1,117 @@
+"""Benchmark: flash-checkpoint save stall vs synchronous disk save.
+
+The reference's headline flash-checkpoint claim is ~10x less
+training-blocking time than a synchronous NVMe save (GPT-2 xl;
+``docs/blogs/flash_checkpoint.md:361-383``; BASELINE.md).  This bench
+measures, on the real chip, the training stall of a flash save (the
+device->host shm copy, everything else async in the agent) against a
+synchronous save-to-disk of the same state, and reports the speedup.
+``vs_baseline`` is our speedup divided by the reference's published
+10x.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "x", "vs_baseline": N}
+"""
+
+import json
+import os
+import pickle
+import shutil
+import sys
+import tempfile
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import (
+        AsyncCheckpointSaver,
+        SaverConfig,
+    )
+    from dlrover_tpu.models.gpt import GPT, GPTConfig, count_params
+    from dlrover_tpu.trainer.elastic_trainer import TrainState
+
+    workdir = tempfile.mkdtemp(prefix="dlrover_bench_")
+    os.environ.setdefault(
+        "DLROVER_SHARED_DIR", os.path.join(workdir, "sockets")
+    )
+
+    # GPT-2 small + adam: ~124M params x3 states ~1.5 GB fp32 pytree
+    cfg = GPTConfig.gpt2_small(max_seq_len=512)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=512)
+    optimizer = optax.adam(1e-4)
+    state = TrainState.create(params, optimizer)
+    jax.block_until_ready(state.params)
+    n_params = count_params(params)
+
+    state_dict = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "step": 100,
+    }
+
+    # -- synchronous disk save (the baseline path flash ckpt replaces)
+    sync_dir = os.path.join(workdir, "sync")
+    os.makedirs(sync_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    host_state = jax.device_get(state_dict)
+    with open(os.path.join(sync_dir, "ckpt.pkl"), "wb") as f:
+        pickle.dump(host_state, f)
+    f_sync = time.perf_counter() - t0
+
+    # -- flash save: stall is only the device->shm copy
+    ckpt_dir = os.path.join(workdir, "flash")
+    AsyncCheckpointSaver.reset()
+    saver = AsyncCheckpointSaver(
+        SaverConfig(
+            checkpoint_dir=ckpt_dir, local_shard_num=1,
+            global_shard_num=1, node_rank=0,
+        )
+    )
+    AsyncCheckpointSaver._instance = saver
+    engine = CheckpointEngine(
+        ckpt_dir, replicated=True, local_rank=0, global_rank=0,
+        world_size=1,
+    )
+    # warm up shm allocation (first save pays the mmap fault-in)
+    engine.save_to_memory(1, state_dict)
+    t0 = time.perf_counter()
+    engine.save_to_storage(2, state_dict)
+    f_flash = time.perf_counter() - t0
+
+    # let the async persist finish before tearing the tempdir down
+    from dlrover_tpu.common.constants import CheckpointConstant
+
+    tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
+    deadline = time.time() + 300
+    while time.time() < deadline and not os.path.exists(tracker):
+        time.sleep(0.5)
+
+    speedup = f_sync / max(f_flash, 1e-9)
+    result = {
+        "metric": "flash_ckpt_stall_speedup_vs_sync_disk",
+        "value": round(speedup, 2),
+        "unit": "x",
+        # reference claims ~10x vs NVMe sync save
+        "vs_baseline": round(speedup / 10.0, 3),
+        "detail": {
+            "sync_save_s": round(f_sync, 3),
+            "flash_stall_s": round(f_flash, 3),
+            "num_params": n_params,
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    engine.close()
+    AsyncCheckpointSaver.reset()
+    shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

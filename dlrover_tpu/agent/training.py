@@ -183,6 +183,17 @@ class ElasticTrainingAgent:
         base_rank = outcome.base_rank(self._node_rank)
         env = dict(os.environ)
         env.update(self._spec.env)
+        # make the framework importable in workers even when not
+        # pip-installed (script-mode sys.path only has the script dir)
+        import dlrover_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(dlrover_tpu.__file__))
+        pythonpath = env.get("PYTHONPATH", "")
+        if pkg_root not in pythonpath.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                f"{pkg_root}{os.pathsep}{pythonpath}" if pythonpath
+                else pkg_root
+            )
         env.update(
             {
                 NodeEnv.COORDINATOR_ADDR: outcome.coordinator,

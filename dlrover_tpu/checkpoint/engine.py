@@ -78,6 +78,11 @@ class CheckpointEngine:
         self._notified_agent = False
         self._deletion_keep_latest = deletion_keep_latest
         self._cached_step = -1
+        # ship the saver config now so the agent-side saver (and its
+        # shm/meta/lock servers) exists before the first load()
+        # (reference creates the saver at engine construction too,
+        # engine.py:253)
+        self._notify_agent_to_create_saver()
 
     @property
     def global_shard_num(self) -> int:

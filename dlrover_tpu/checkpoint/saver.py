@@ -90,7 +90,7 @@ class AsyncCheckpointSaver:
     def __init__(self, config: SaverConfig,
                  storage: Optional[CheckpointStorage] = None):
         self.config = config
-        self.storage = storage or get_checkpoint_storage(config.storage_type)
+        self.storage = storage or get_checkpoint_storage()
         self._shm_handlers = [
             SharedMemoryHandler(r, host=True)
             for r in range(config.local_shard_num)

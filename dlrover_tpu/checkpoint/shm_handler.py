@@ -21,6 +21,11 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+try:  # registers bfloat16/fp8 dtypes with numpy for np.dtype(str)
+    import ml_dtypes  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import (
     PersistentSharedMemory,
@@ -116,16 +121,33 @@ class SharedMemoryHandler:
     # -- write (trainer side) ---------------------------------------------
 
     def save_state_dict(self, state_dict, config: CheckpointConfig):
-        """Serialize the pytree into shm and publish the meta dict."""
+        """Serialize the pytree into shm and publish the meta dict.
+
+        Device->host transfers are issued for the whole pytree at once
+        (``jax.device_get`` parallelizes them) and each host array is
+        memcpy'd straight into an shm view — no intermediate bytes
+        objects.  This is the synchronous stall of a flash save, so
+        copies are minimized (reference hot path:
+        _traverse_copy_to_shm, ckpt_saver.py:174).
+        """
         flat = _flatten_state_dict(state_dict)
         arrays: Dict[str, np.ndarray] = {}
         scalars: Dict[str, Any] = {}
+        device_keys = []
         for key, leaf in flat.items():
-            arr = self._to_numpy(leaf)
-            if arr is not None:
-                arrays[key] = arr
+            if isinstance(leaf, (np.ndarray, np.generic)):
+                arrays[key] = np.ascontiguousarray(leaf)
+            elif type(leaf).__module__.startswith(("jaxlib", "jax")):
+                arrays[key] = leaf  # fetched in one batched device_get
+                device_keys.append(key)
             else:
                 scalars[key] = leaf
+        if device_keys:
+            import jax
+
+            fetched = jax.device_get([arrays[k] for k in device_keys])
+            for k, host in zip(device_keys, fetched):
+                arrays[k] = np.ascontiguousarray(host)
         scalar_blob = pickle.dumps(scalars)
 
         metas: Dict[str, TensorMeta] = {}
@@ -152,7 +174,10 @@ class SharedMemoryHandler:
             buf = self._shm.buf
             for key, arr in arrays.items():
                 m = metas[key]
-                buf[m.offset:m.offset + m.nbytes] = arr.tobytes()
+                dst = np.frombuffer(
+                    buf, dtype=arr.dtype, count=arr.size, offset=m.offset
+                ).reshape(arr.shape)
+                np.copyto(dst, arr)
             buf[offset:offset + len(scalar_blob)] = scalar_blob
             config.writing = False
             self._publish_meta(metas, config, offset, len(scalar_blob))
@@ -160,23 +185,6 @@ class SharedMemoryHandler:
             "rank %s wrote %.1f MB checkpoint step %s to shm",
             self._rank, total / 2**20, config.step,
         )
-
-    @staticmethod
-    def _to_numpy(leaf) -> Optional[np.ndarray]:
-        """Array leaf -> contiguous host ndarray; None for non-arrays.
-
-        For jax.Array this is the device->host copy — the synchronous
-        part of a flash save (reference: the GPU->CPU memcpy in
-        _traverse_copy_to_shm, ckpt_saver.py:174).
-        """
-        if isinstance(leaf, np.ndarray):
-            return np.ascontiguousarray(leaf)
-        # jax.Array without importing jax at module scope for the agent
-        if type(leaf).__module__.startswith(("jaxlib", "jax")):
-            return np.ascontiguousarray(np.asarray(leaf))
-        if isinstance(leaf, (np.generic,)):
-            return np.ascontiguousarray(np.asarray(leaf))
-        return None
 
     def _publish_meta(
         self, metas: Dict[str, TensorMeta], config: CheckpointConfig,
@@ -194,10 +202,10 @@ class SharedMemoryHandler:
     # -- read (agent side / restore) --------------------------------------
 
     def metadata(self) -> Dict[str, Any]:
-        return self._meta.get()
+        return self._meta.get(default_if_absent=True)
 
     def get_checkpoint_config(self) -> Optional[CheckpointConfig]:
-        meta = self._meta.get()
+        meta = self._meta.get(default_if_absent=True)
         return meta.get("config") if meta else None
 
     def no_checkpoint_state(self) -> bool:
@@ -215,7 +223,7 @@ class SharedMemoryHandler:
     def load_state_dict(self) -> Tuple[Optional[CheckpointConfig], Any]:
         """Zero-copy-read the shm snapshot back into a nested dict of
         numpy arrays (caller device_puts with its shardings)."""
-        meta = self._meta.get()
+        meta = self._meta.get(default_if_absent=True)
         if not meta:
             return None, {}
         config: CheckpointConfig = meta["config"]
@@ -245,7 +253,7 @@ class SharedMemoryHandler:
     def read_raw(self) -> Tuple[Optional[CheckpointConfig], bytes, Dict]:
         """Raw bytes + meta for the agent's persist path (no pytree
         reconstruction, just shm -> storage streaming)."""
-        meta = self._meta.get()
+        meta = self._meta.get(default_if_absent=True)
         if not meta:
             return None, b"", {}
         config: CheckpointConfig = meta["config"]

@@ -293,9 +293,14 @@ class SharedDict(LocalSocketComm):
             return self._handle(("set", d))
         return self._request("set", d)
 
-    def get(self) -> Dict:
+    def get(self, default_if_absent: bool = False) -> Dict:
+        """``default_if_absent=True`` returns {} immediately when no
+        server socket exists (e.g. reading checkpoint meta before any
+        saver was created) instead of polling for 300 s."""
         if self._create:
             return self._handle(("getall",))
+        if default_if_absent and not os.path.exists(self._path):
+            return {}
         return self._request("getall")
 
 

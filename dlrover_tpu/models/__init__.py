@@ -1,0 +1,6 @@
+"""Model zoo: TPU-native reference models used by the trainer, the
+strategy engine's dry-runner, and the benchmarks."""
+
+from dlrover_tpu.models.gpt import GPT, GPTConfig
+
+__all__ = ["GPT", "GPTConfig"]

@@ -1,0 +1,199 @@
+"""Decoder-only transformer (GPT family) — the flagship model.
+
+The reference accelerates HF torch models (GPT2/Llama/GLM blocks in
+``atorch/modules/distributed_modules/transformer.py``, flash-attn
+swaps in ``modules/transformer/layers.py``); the TPU rebuild ships its
+own flax implementation designed for the MXU and GSPMD from the
+start:
+
+- bf16 activations/params by policy, fp32 residual-stream layernorms;
+- one fused qkv projection (single large matmul for the MXU);
+- attention is pluggable so the Pallas flash-attention kernel in
+  :mod:`dlrover_tpu.ops.flash_attention` can replace the XLA path;
+- param names line up with the partition-rule sets in
+  :mod:`dlrover_tpu.parallel.sharding` (q_proj/o_proj/fc_in/fc_out,
+  wte/wpe) so DP/FSDP/TP are pure sharding changes, no module swaps;
+- ``remat`` option wraps each block with ``jax.checkpoint`` (the
+  reference's activation-checkpoint optimization,
+  ``auto/opt_lib/checkpoint_optimization.py``).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AttentionFn = Callable[..., jax.Array]
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # GPT-2 vocab padded to a multiple of 128
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_dim: int = 768
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16       # activation/compute dtype (MXU)
+    param_dtype: Any = jnp.float32  # master params
+    remat: bool = False
+    # "xla" = dot-product attention lowered by XLA; "flash" = Pallas
+    attention_impl: str = "xla"
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_dim // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPTConfig":
+        return cls(
+            vocab_size=256, max_seq_len=128, num_layers=2, num_heads=4,
+            hidden_dim=64, **kw,
+        )
+
+    @classmethod
+    def gpt2_small(cls, **kw) -> "GPTConfig":
+        return cls(num_layers=12, num_heads=12, hidden_dim=768, **kw)
+
+    @classmethod
+    def gpt2_xl(cls, **kw) -> "GPTConfig":
+        return cls(
+            num_layers=48, num_heads=25, hidden_dim=1600,
+            max_seq_len=1024, **kw,
+        )
+
+
+def xla_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, dtype=jnp.bfloat16
+) -> jax.Array:
+    """Plain causal attention; XLA fuses softmax chains well on TPU.
+
+    q,k,v: [batch, seq, heads, head_dim] -> same shape out.
+    """
+    seq = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def get_attention_fn(impl: str) -> AttentionFn:
+    if impl == "flash":
+        from dlrover_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention
+    return xla_causal_attention
+
+
+class Attention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s, d = x.shape
+        # fused qkv: one [d, 3d] matmul keeps the MXU busy
+        qkv = nn.Dense(
+            3 * d, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="qkv",
+        )(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        attn_fn = get_attention_fn(cfg.attention_impl)
+        out = attn_fn(q, k, v, dtype=cfg.dtype)
+        out = out.reshape(b, s, d)
+        return nn.Dense(
+            d, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o_proj",
+        )(out)
+
+
+class MLP(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        h = nn.Dense(
+            cfg.mlp_ratio * cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="fc_in",
+        )(x)
+        h = nn.gelu(h)
+        return nn.Dense(
+            cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="fc_out",
+        )(h)
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        # fp32 layernorms on the residual stream for stability
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        x = x + Attention(cfg, name="attn")(h.astype(cfg.dtype))
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        x = x + MLP(cfg, name="mlp")(h.astype(cfg.dtype))
+        return x
+
+
+class GPT(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s = tokens.shape
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.max_seq_len, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wpe",
+        )
+        x = wte(tokens) + wpe(jnp.arange(s)[None])
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = wte.attend(x.astype(cfg.dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+    def init_params(self, rng, batch_size: int = 2, seq_len: int = 0):
+        seq_len = seq_len or min(self.config.max_seq_len, 128)
+        tokens = jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token cross entropy; fp32 for the reduction."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return nll.mean()
+
+
+def count_params(params) -> int:
+    return sum(
+        int(x.size) for x in jax.tree_util.tree_leaves(params)
+    )

@@ -1,0 +1,3 @@
+"""TPU kernels (Pallas) and native ops — the rebuild's equivalents of
+the reference's CUDA/C++ kernel layer (TFPlus flash-attn binding,
+ATorch quantization kernels; SURVEY.md §2.7)."""

@@ -1,0 +1,19 @@
+"""Parallelism layer: device meshes, sharding rules, and the
+collective patterns (DP/FSDP/TP/SP/EP) that replace the reference's
+process-group zoo (``atorch/distributed/distributed.py``,
+``modules/distributed_modules/``) with GSPMD shardings."""
+
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.parallel.sharding import (
+    PartitionRules,
+    named_sharding,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshConfig",
+    "PartitionRules",
+    "build_mesh",
+    "named_sharding",
+    "shard_pytree",
+]

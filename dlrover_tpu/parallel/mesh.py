@@ -1,0 +1,112 @@
+"""Device-mesh construction.
+
+Replaces the reference's nested NCCL process-group slicing
+(``create_parallel_group``, ``atorch/distributed/distributed.py:323``)
+with one ``jax.sharding.Mesh`` whose named axes carry every
+parallelism flavour.  Axis names:
+
+- ``data``:  pure data parallelism (batch split, params replicated)
+- ``fsdp``:  data parallelism with parameter/optimizer sharding
+  (ZeRO-3 parity) — batch is split over ``data`` x ``fsdp``
+- ``tensor``: Megatron-style tensor parallelism
+- ``sequence``: Ulysses-style sequence parallelism (all-to-all)
+- ``expert``: MoE expert parallelism
+- ``pipeline``: pipeline stages (collective-permute microbatching)
+
+On a TPU pod slice the mesh should be laid out so ``tensor`` and
+``fsdp`` ride ICI while ``data`` may span DCN; ``jax.experimental
+.mesh_utils.create_device_mesh`` handles the physical topology
+ordering.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES = ("data", "fsdp", "tensor", "sequence", "expert", "pipeline")
+
+
+@dataclass
+class MeshConfig:
+    """Logical mesh shape; -1 on ``data`` absorbs remaining devices."""
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    expert: int = 1
+    pipeline: int = 1
+
+    def axis_sizes(self, num_devices: int) -> Dict[str, int]:
+        sizes = {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "sequence": self.sequence,
+            "expert": self.expert,
+            "pipeline": self.pipeline,
+        }
+        fixed = 1
+        for name, size in sizes.items():
+            if size > 0:
+                fixed *= size
+        unknown = [n for n, s in sizes.items() if s <= 0]
+        if len(unknown) > 1:
+            raise ValueError(f"only one axis may be -1, got {unknown}")
+        if unknown:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes "
+                    f"product {fixed}"
+                )
+            sizes[unknown[0]] = num_devices // fixed
+        else:
+            if fixed != num_devices:
+                raise ValueError(
+                    f"mesh {sizes} needs {fixed} devices, have "
+                    f"{num_devices}"
+                )
+        return sizes
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshConfig":
+        return cls(**{k: v for k, v in d.items() if k in AXES})
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a Mesh over the global device set.
+
+    Uses ``mesh_utils.create_device_mesh`` so the axis order maps onto
+    the physical ICI torus (fastest-varying axes get the tightest
+    rings) — the TPU analog of the reference's switch-topology-aware
+    rank sorting (``master/elastic_training/net_topology.py``).
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    config = config or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = config.axis_sizes(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices)
+        )
+    except (ValueError, AssertionError):
+        # non-TPU or odd shapes: plain reshape keeps semantics
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def batch_axes() -> Tuple[str, ...]:
+    """Mesh axes the global batch is split over."""
+    return ("data", "fsdp")
+
+
+def dp_world_size(mesh) -> int:
+    return mesh.shape["data"] * mesh.shape["fsdp"]

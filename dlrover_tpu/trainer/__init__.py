@@ -1,0 +1,8 @@
+"""In-process training library (reference: ``dlrover/trainer/`` —
+ElasticTrainer, ElasticDistributedSampler, flash-checkpoint front
+ends) rebuilt around jitted JAX train steps."""
+
+from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer, TrainState
+from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
+
+__all__ = ["ElasticTrainer", "ElasticDistributedSampler", "TrainState"]

@@ -1,0 +1,230 @@
+"""Elastic training loop utilities.
+
+Reference: ``ElasticTrainer``
+(``dlrover/trainer/torch/elastic/trainer.py``): keeps the *global*
+batch size fixed as the world resizes by adjusting gradient
+accumulation, counts steps, and writes a runtime-metrics file the
+agent's TrainingMonitor reports to the master's SpeedMonitor.
+
+TPU-native shape: instead of wrapping a torch optimizer, the trainer
+builds one jitted train step that scans over the gradient-accumulation
+microbatches inside the compiled program (``lax.scan`` — no Python
+loop, one XLA program per world size) and applies the optax update.
+Sharding: params/opt-state placed by partition rules, batch split over
+the data axes; XLA inserts the gradient psum.
+"""
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.mesh import dp_world_size
+from dlrover_tpu.parallel.sharding import (
+    PartitionRules,
+    batch_spec,
+    sharding_tree,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    """Minimal train state pytree (params + optax state + step)."""
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params, optimizer):
+        return cls(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer,
+    grad_accum: int = 1,
+    mesh=None,
+    rules: Optional[PartitionRules] = None,
+):
+    """Build the jitted (state, batch) -> (state, metrics) step.
+
+    ``loss_fn(params, batch) -> scalar``.  With ``grad_accum > 1`` the
+    batch's leading dim must be ``grad_accum * micro``; the scan keeps
+    the accumulation inside the compiled program.  When a mesh is
+    given, in/out shardings pin state to the rule-derived placement and
+    the batch to the data axes — GSPMD inserts all collectives.
+    """
+
+    def grads_of(params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        return loss, grads
+
+    def step_fn(state: TrainState, batch):
+        if grad_accum > 1:
+            micro = jax.tree.map(
+                lambda x: x.reshape(
+                    (grad_accum, x.shape[0] // grad_accum) + x.shape[1:]
+                ),
+                batch,
+            )
+
+            def accum(carry, mb):
+                loss_sum, grads_sum = carry
+                loss, grads = grads_of(state.params, mb)
+                return (
+                    loss_sum + loss,
+                    jax.tree.map(jnp.add, grads_sum, grads),
+                ), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (loss_sum, grads), _ = jax.lax.scan(
+                accum, (jnp.zeros((), jnp.float32), zeros), micro
+            )
+            loss = loss_sum / grad_accum
+            grads = jax.tree.map(lambda g: g / grad_accum, grads)
+        else:
+            loss, grads = grads_of(state.params, batch)
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        import optax
+
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt, step=state.step + 1
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=0)
+
+    rules = rules or PartitionRules()
+    from jax.sharding import NamedSharding
+
+    def jit_with_shardings(state_example):
+        state_sh = sharding_tree(state_example, mesh, rules)
+        batch_sh = NamedSharding(mesh, batch_spec())
+        return jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, None),
+            donate_argnums=0,
+        )
+
+    return step_fn, jit_with_shardings
+
+
+class ElasticTrainer:
+    """Step/epoch accounting with a fixed global batch across resizes
+    (reference: trainer.py GradientState + _ElasticOptimizer)."""
+
+    def __init__(
+        self,
+        global_batch_size: int,
+        micro_batch_size: int,
+        dp_size: Optional[int] = None,
+        metrics_path: Optional[str] = None,
+    ):
+        self.global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+        self.dp_size = dp_size or env_utils.get_world_size()
+        if global_batch_size % (micro_batch_size * self.dp_size):
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"micro {micro_batch_size} x dp {self.dp_size}"
+            )
+        self.grad_accum = global_batch_size // (
+            micro_batch_size * self.dp_size
+        )
+        self.global_step = 0
+        self._metrics_path = metrics_path or os.getenv(
+            "DLROVER_METRICS_FILE",
+            os.path.join("/tmp", f"dlrover_metrics_{os.getuid()}.json"),
+        )
+        self._epoch = 0
+        logger.info(
+            "elastic trainer: global_batch=%s micro=%s dp=%s accum=%s",
+            global_batch_size, micro_batch_size, self.dp_size,
+            self.grad_accum,
+        )
+
+    @property
+    def local_batch_size(self) -> int:
+        """Samples this data-parallel rank consumes per step."""
+        return self.micro_batch_size * self.grad_accum
+
+    def report_step(self, metrics: Optional[Dict[str, float]] = None):
+        """Advance the step counter and write the metrics file the
+        agent monitor tails (reference: trainer.py report to file +
+        monitor/training.py)."""
+        self.global_step += 1
+        record = {
+            "global_step": self.global_step,
+            "timestamp": time.time(),
+            "epoch": self._epoch,
+        }
+        if metrics:
+            record.update(
+                {
+                    k: float(v)
+                    for k, v in metrics.items()
+                    if jnp.isscalar(v) or getattr(v, "ndim", 1) == 0
+                }
+            )
+        tmp = self._metrics_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, self._metrics_path)
+        except OSError as e:
+            logger.debug("metrics file write failed: %s", e)
+
+    def set_epoch(self, epoch: int):
+        self._epoch = epoch
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"global_step": self.global_step, "epoch": self._epoch}
+
+    def load_state_dict(self, state: Dict[str, int]):
+        self.global_step = int(state.get("global_step", 0))
+        self._epoch = int(state.get("epoch", 0))
+
+
+def init_jax_distributed():
+    """Initialize multi-host JAX from the agent's env contract
+    (reference analog: dist.init_process_group with MASTER_ADDR/PORT
+    set by the agent, training.py:430-447)."""
+    coordinator = env_utils.get_coordinator_addr()
+    num_processes = int(
+        os.getenv("DLROVER_NUM_PROCESSES", "1")
+    )
+    if not coordinator or num_processes <= 1:
+        return False
+    process_id = int(os.getenv("DLROVER_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %s/%s via %s",
+        process_id, num_processes, coordinator,
+    )
+    return True

@@ -1,0 +1,230 @@
+"""Parallel layer + model + trainer tests on the virtual 8-device CPU
+mesh: mesh construction, partition rules, sharded train steps (the
+multi-chip path the driver dry-runs), sampler elasticity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models.gpt import (
+    GPT,
+    GPTConfig,
+    count_params,
+    cross_entropy_loss,
+)
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh, dp_world_size
+from dlrover_tpu.parallel.sharding import (
+    PartitionRules,
+    batch_spec,
+    fsdp_rules,
+    gpt_tp_rules,
+    shard_pytree,
+    sharding_tree,
+    tree_paths,
+)
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticTrainer,
+    TrainState,
+    make_train_step,
+)
+from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
+
+
+def test_mesh_config_resolution():
+    cfg = MeshConfig(data=-1, fsdp=2, tensor=2)
+    sizes = cfg.axis_sizes(8)
+    assert sizes == {
+        "data": 2, "fsdp": 2, "tensor": 2, "sequence": 1, "expert": 1,
+        "pipeline": 1,
+    }
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, fsdp=3).axis_sizes(8)
+
+
+def test_build_mesh_8_devices():
+    mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+    assert mesh.shape["data"] == 2
+    assert dp_world_size(mesh) == 4
+
+
+def test_partition_rules_match_gpt_params():
+    model = GPT(GPTConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    paths = tree_paths(params)
+    rules = gpt_tp_rules()
+    qkv = [p for p in paths if "qkv/kernel" in p]
+    assert qkv
+    spec = rules.spec_for(qkv[0])
+    assert tuple(spec) == ("fsdp", "tensor")
+    ln = [p for p in paths if "ln_attn/scale" in p]
+    assert tuple(rules.spec_for(ln[0])) == ()
+
+
+def test_shard_pytree_places_params():
+    mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+    model = GPT(GPTConfig.tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    sharded = shard_pytree(params, mesh, fsdp_rules())
+    emb = sharded["wte"]["embedding"]
+    # vocab dim divided over fsdp
+    assert emb.sharding.is_fully_replicated is False
+
+
+def test_gpt_forward_shapes():
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert count_params(params) > 0
+
+
+def test_train_step_single_device_loss_decreases():
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    optimizer = optax.adam(1e-2)
+    state = TrainState.create(params, optimizer)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    step = make_train_step(loss_fn, optimizer)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
+    batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
+    state, m0 = step(state, batch)
+    losses = [float(m0["loss"])]
+    for _ in range(10):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 11
+
+
+def test_train_step_grad_accum_matches_full_batch():
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    optimizer = optax.sgd(1e-1)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
+    batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
+
+    # copy params per state: the jitted step donates its input state
+    s_full = TrainState.create(jax.tree.map(jnp.copy, params), optimizer)
+    s_full, _ = make_train_step(loss_fn, optimizer)(s_full, batch)
+    s_acc = TrainState.create(jax.tree.map(jnp.copy, params), optimizer)
+    s_acc, _ = make_train_step(loss_fn, optimizer, grad_accum=4)(
+        s_acc, batch
+    )
+    w_full = s_full.params["wte"]["embedding"]
+    w_acc = s_acc.params["wte"]["embedding"]
+    np.testing.assert_allclose(
+        np.asarray(w_full), np.asarray(w_acc), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_sharded_train_step_on_mesh():
+    """The multi-chip training path: jit over the 8-device mesh with
+    TP+FSDP+DP shardings (what dryrun_multichip exercises)."""
+    mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    optimizer = optax.adam(1e-3)
+    state = TrainState.create(params, optimizer)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    rules = gpt_tp_rules()
+    _, jit_builder = make_train_step(
+        loss_fn, optimizer, mesh=mesh, rules=rules
+    )
+    step = jit_builder(state)
+    state = jax.device_put(state, sharding_tree(state, mesh, rules))
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
+    from jax.sharding import NamedSharding
+
+    batch = jax.device_put(
+        {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])},
+        NamedSharding(mesh, batch_spec()),
+    )
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 1
+
+
+def test_elastic_trainer_grad_accum_adjusts_to_world():
+    t4 = ElasticTrainer(
+        global_batch_size=64, micro_batch_size=4, dp_size=4
+    )
+    assert t4.grad_accum == 4
+    t8 = ElasticTrainer(
+        global_batch_size=64, micro_batch_size=4, dp_size=8
+    )
+    assert t8.grad_accum == 2  # world grew, accumulation shrank
+    assert (
+        t4.local_batch_size * 4 == t8.local_batch_size * 8 == 64
+    )
+
+
+def test_elastic_trainer_metrics_file(tmp_path):
+    path = str(tmp_path / "metrics.json")
+    t = ElasticTrainer(
+        global_batch_size=8, micro_batch_size=8, dp_size=1,
+        metrics_path=path,
+    )
+    t.report_step({"loss": jnp.asarray(1.5)})
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["global_step"] == 1 and rec["loss"] == 1.5
+
+
+def test_sampler_strided_and_resumable():
+    s = ElasticDistributedSampler(20, num_replicas=2, rank=0,
+                                  shuffle=False)
+    first = [next(iter_) for iter_, _ in [(iter(s), None)]]
+    indices = list(ElasticDistributedSampler(
+        20, num_replicas=2, rank=0, shuffle=False))
+    assert indices == list(range(0, 20, 2))
+
+    # consume 3, checkpoint, resume with a DIFFERENT world size
+    s2 = ElasticDistributedSampler(20, num_replicas=2, rank=1,
+                                   shuffle=False)
+    it = iter(s2)
+    for _ in range(3):
+        next(it)
+    state = s2.state_dict()
+    s4 = ElasticDistributedSampler(20, num_replicas=4, rank=0,
+                                   shuffle=False)
+    s4.load_state_dict(state)
+    resumed = list(s4)
+    # 6 samples consumed globally (3 per each of 2 old ranks is 3*2);
+    # new rank 0 of 4 starts at global position 4 (6//4*4) + rank
+    assert resumed[0] >= 4
+
+
+def test_sampler_shuffle_deterministic_per_epoch():
+    a = list(ElasticDistributedSampler(16, 2, 0, shuffle=True, seed=3))
+    b = list(ElasticDistributedSampler(16, 2, 0, shuffle=True, seed=3))
+    assert a == b
+    s = ElasticDistributedSampler(16, 2, 0, shuffle=True, seed=3)
+    s.set_epoch(1)
+    c = list(s)
+    assert c != a
