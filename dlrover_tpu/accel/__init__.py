@@ -1,0 +1,22 @@
+"""Auto-acceleration: strategy search emitting GSPMD shardings.
+
+TPU-native rebuild of ATorch's ``auto_accelerate`` subsystem
+(``atorch/atorch/auto/``): instead of wrapping the model in
+DDP/FSDP/TP module wrappers, a strategy here is a declarative bundle —
+mesh shape + partition rules + remat/dtype policy + grad accumulation —
+applied by jitting one train step with those shardings.
+"""
+
+from dlrover_tpu.accel.accelerate import AccelerateResult, auto_accelerate
+from dlrover_tpu.accel.model_context import ModelContext
+from dlrover_tpu.accel.opt_lib import OptimizationLibrary
+from dlrover_tpu.accel.strategy import AccelPlan, Strategy
+
+__all__ = [
+    "AccelPlan",
+    "AccelerateResult",
+    "ModelContext",
+    "OptimizationLibrary",
+    "Strategy",
+    "auto_accelerate",
+]

@@ -1,0 +1,291 @@
+"""auto_accelerate: strategy -> plan -> jitted sharded train step.
+
+Reference: ``auto_accelerate()`` (``atorch/auto/accelerate.py:406``):
+wrap (model, optim, dataset, loss) into a ModelContext, load or search
+a Strategy, apply transforms, return the accelerated artifacts.  The
+TPU result is a compiled train step with GSPMD shardings instead of a
+wrapped torch model.
+"""
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accel.analyser import analyse, fits_in_hbm
+from dlrover_tpu.accel.model_context import ModelContext
+from dlrover_tpu.accel.opt_lib import OptimizationLibrary
+from dlrover_tpu.accel.strategy import AccelPlan, Strategy
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.mesh import build_mesh
+from dlrover_tpu.parallel.sharding import batch_spec, sharding_tree
+from dlrover_tpu.trainer.elastic_trainer import TrainState
+
+
+@dataclass
+class BuiltPlan:
+    mesh: Any
+    train_step: Callable
+    state: Any
+    plan: AccelPlan
+    model: Any
+
+    def place_batch(self, batch):
+        from jax.sharding import NamedSharding
+
+        return jax.device_put(
+            batch, NamedSharding(self.mesh, batch_spec())
+        )
+
+
+@dataclass
+class AccelerateResult:
+    train_step: Callable
+    state: Any
+    mesh: Any
+    plan: AccelPlan
+    strategy: Strategy
+    model: Any
+    place_batch: Callable
+
+
+def _apply_plan_to_model(plan: AccelPlan, context: ModelContext):
+    """Rebuild the model with plan-driven config knobs (remat,
+    attention impl, compute dtype) when the model exposes a dataclass
+    config — the TPU analog of module replacement."""
+    model = context.model
+    cfg = getattr(model, "config", None)
+    if cfg is None or not dataclasses.is_dataclass(cfg):
+        return model
+    updates: Dict[str, Any] = {}
+    if hasattr(cfg, "remat") and plan.remat != cfg.remat:
+        updates["remat"] = plan.remat
+    if (
+        hasattr(cfg, "attention_impl")
+        and plan.attention_impl != cfg.attention_impl
+    ):
+        updates["attention_impl"] = plan.attention_impl
+    dtype_map = {
+        "bfloat16": jnp.bfloat16, "float32": jnp.float32,
+        "float16": jnp.float16,
+    }
+    if hasattr(cfg, "dtype") and plan.compute_dtype in dtype_map:
+        if cfg.dtype != dtype_map[plan.compute_dtype]:
+            updates["dtype"] = dtype_map[plan.compute_dtype]
+    if not updates:
+        return model
+    new_cfg = dataclasses.replace(cfg, **updates)
+    return type(model)(new_cfg)
+
+
+def state_shardings(state: TrainState, mesh, plan: AccelPlan):
+    """Params follow param_rules; optimizer state follows
+    opt_state_rules (ZeRO-1/2 shards only the latter)."""
+    return TrainState(
+        params=sharding_tree(state.params, mesh, plan.param_rules),
+        opt_state=sharding_tree(
+            state.opt_state, mesh, plan.effective_opt_rules()
+        ),
+        step=sharding_tree(state.step, mesh, plan.param_rules),
+    )
+
+
+def build_from_plan(
+    plan: AccelPlan, context: ModelContext, devices=None
+) -> BuiltPlan:
+    """Materialize a plan: mesh, model rebuild, sharded jitted step."""
+    from jax.sharding import NamedSharding
+
+    mesh = build_mesh(plan.mesh_config, devices=devices)
+    model = _apply_plan_to_model(plan, context)
+    rebuilt_ctx = dataclasses.replace(context, model=model)
+    params = rebuilt_ctx.init_params()
+    optimizer = context.optimizer()
+    state = TrainState.create(params, optimizer)
+
+    loss_fn = context.loss_fn
+
+    def wrapped_loss(p, batch):
+        return loss_fn(p, batch, model=model) if _wants_model(
+            loss_fn
+        ) else loss_fn(p, batch)
+
+    import optax
+
+    def step_fn(state: TrainState, batch):
+        if plan.grad_accum > 1:
+            micro = jax.tree.map(
+                lambda x: x.reshape(
+                    (plan.grad_accum, x.shape[0] // plan.grad_accum)
+                    + x.shape[1:]
+                ),
+                batch,
+            )
+
+            def accum(carry, mb):
+                loss_sum, grads_sum = carry
+                loss, grads = jax.value_and_grad(wrapped_loss)(
+                    state.params, mb
+                )
+                return (
+                    loss_sum + loss,
+                    jax.tree.map(jnp.add, grads_sum, grads),
+                ), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (loss_sum, grads), _ = jax.lax.scan(
+                accum, (jnp.zeros((), jnp.float32), zeros), micro
+            )
+            loss = loss_sum / plan.grad_accum
+            grads = jax.tree.map(
+                lambda g: g / plan.grad_accum, grads
+            )
+        else:
+            loss, grads = jax.value_and_grad(wrapped_loss)(
+                state.params, batch
+            )
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                params=new_params, opt_state=new_opt,
+                step=state.step + 1,
+            ),
+            {"loss": loss, "grad_norm": optax.global_norm(grads)},
+        )
+
+    shardings = state_shardings(state, mesh, plan)
+    batch_sh = NamedSharding(mesh, batch_spec())
+    jitted = jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, None),
+        donate_argnums=0,
+    )
+    state = jax.device_put(state, shardings)
+    return BuiltPlan(
+        mesh=mesh, train_step=jitted, state=state, plan=plan,
+        model=model,
+    )
+
+
+def _wants_model(fn) -> bool:
+    import inspect
+
+    try:
+        return "model" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# strategy search (reference: AccelerationEngine + combination_sg,
+# auto/engine/)
+# ---------------------------------------------------------------------------
+
+
+def candidate_strategies(
+    context: ModelContext, num_devices: int
+) -> List[Strategy]:
+    """Combination strategy generation pruned by the memory model
+    (reference: combination_sg.py + analyser features)."""
+    analysis = analyse(context)
+    cands: List[Strategy] = []
+
+    def add(opts, fsdp=1, tensor=1, remat=False):
+        if fits_in_hbm(analysis, fsdp, tensor, remat):
+            cands.append(Strategy(opts=opts))
+
+    add([("parallel_mode", {}), ("amp_native", {})])
+    add([("zero1", {}), ("amp_native", {})], fsdp=num_devices)
+    add([("fsdp", {}), ("amp_native", {})], fsdp=num_devices)
+    add(
+        [("fsdp", {}), ("amp_native", {}), ("checkpoint", {})],
+        fsdp=num_devices, remat=True,
+    )
+    if num_devices % 2 == 0 and num_devices > 1:
+        add(
+            [
+                ("mixed_parallel", {"tensor": 2, "fsdp": 1,
+                                    "data": -1}),
+                ("amp_native", {}),
+            ],
+            tensor=2,
+        )
+    # always at least pure DP as a fallback
+    if not cands:
+        cands.append(Strategy(opts=[("parallel_mode", {})]))
+    return cands
+
+
+def auto_accelerate(
+    model,
+    optim_factory: Callable,
+    loss_fn: Callable,
+    sample_batch,
+    strategy: Optional[Strategy] = None,
+    load_strategy: Optional[str] = None,
+    save_strategy: Optional[str] = None,
+    dry_run_candidates: bool = True,
+    devices=None,
+    grad_accum: int = 1,
+) -> AccelerateResult:
+    """Pick (or load) a strategy and compile the sharded train step.
+
+    Semi-auto: pass ``strategy`` explicitly.  Auto: candidates are
+    generated, memory-pruned, optionally dry-run profiled, and the
+    fastest is kept (reference flow: auto/accelerate.py:406 +
+    engine executor task loop).
+    """
+    context = ModelContext(
+        model=model, optim_factory=optim_factory, loss_fn=loss_fn,
+        sample_batch=sample_batch,
+    )
+    lib = OptimizationLibrary()
+    devices = list(devices) if devices is not None else jax.devices()
+
+    if load_strategy and os.path.exists(load_strategy):
+        strategy = Strategy.load(load_strategy)
+        logger.info("loaded strategy %s", strategy.names())
+
+    if strategy is None:
+        cands = candidate_strategies(context, len(devices))
+        if dry_run_candidates and len(cands) > 1:
+            from dlrover_tpu.accel.dry_runner import profile_plan
+
+            best, best_time = None, float("inf")
+            for cand in cands:
+                plan = lib.apply_strategy(cand, context)
+                plan.grad_accum = grad_accum
+                result = profile_plan(plan, context)
+                logger.info(
+                    "candidate %s: ok=%s step=%.4fs",
+                    cand.names(), result.ok, result.step_time_s,
+                )
+                if result.ok and result.step_time_s < best_time:
+                    best, best_time = cand, result.step_time_s
+            strategy = best or cands[0]
+        else:
+            strategy = cands[0]
+        logger.info("selected strategy %s", strategy.names())
+
+    if save_strategy:
+        strategy.save(save_strategy)
+
+    plan = lib.apply_strategy(strategy, context)
+    plan.grad_accum = grad_accum
+    built = build_from_plan(plan, context, devices=devices)
+    return AccelerateResult(
+        train_step=built.train_step,
+        state=built.state,
+        mesh=built.mesh,
+        plan=plan,
+        strategy=strategy,
+        model=built.model,
+        place_batch=built.place_batch,
+    )

@@ -1,0 +1,95 @@
+"""Model/job analysis feeding the strategy search.
+
+Reference: ``analyser.py`` (``atorch/auto/analyser/``) inspects the
+torch model for param counts/dtypes/module types.  Here we inspect
+the abstract param pytree (``jax.eval_shape`` — no memory allocated)
+and the sample batch to estimate memory needs per strategy.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+@dataclass
+class AnalysisResult:
+    num_params: int = 0
+    param_bytes: int = 0
+    # adam-family optimizer state is ~2x params in fp32
+    opt_state_bytes: int = 0
+    batch_bytes: int = 0
+    seq_len: int = 0
+    batch_size: int = 0
+    largest_param: int = 0
+    per_device_hbm: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def model_state_bytes(self) -> int:
+        return self.param_bytes + self.opt_state_bytes
+
+
+def _device_hbm() -> int:
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if stats and "bytes_limit" in stats:
+        return int(stats["bytes_limit"])
+    # CPU / unknown: assume a v5e-class 16 GB chip for planning
+    return 16 * 1024**3
+
+
+def analyse(context) -> AnalysisResult:
+    """Shape-only analysis (no device memory touched)."""
+    model = context.model
+    rng = jax.random.PRNGKey(0)
+
+    def init_fn():
+        if hasattr(model, "init_params"):
+            return model.init_params(rng)
+        return model.init(rng, context.sample_batch)["params"]
+
+    shapes = jax.eval_shape(init_fn)
+    leaves = jax.tree_util.tree_leaves(shapes)
+    num_params = sum(int(np.prod(x.shape)) for x in leaves)
+    param_bytes = sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves
+    )
+    largest = max((int(np.prod(x.shape)) for x in leaves), default=0)
+
+    batch_leaves = jax.tree_util.tree_leaves(context.sample_batch)
+    batch_bytes = sum(
+        getattr(x, "nbytes", 0) for x in batch_leaves
+    )
+    first = batch_leaves[0] if batch_leaves else None
+    batch_size = int(first.shape[0]) if first is not None else 0
+    seq_len = (
+        int(first.shape[1])
+        if first is not None and first.ndim > 1 else 0
+    )
+
+    return AnalysisResult(
+        num_params=num_params,
+        param_bytes=param_bytes,
+        opt_state_bytes=2 * num_params * 4,  # adam mu+nu fp32
+        batch_bytes=batch_bytes,
+        seq_len=seq_len,
+        batch_size=batch_size,
+        largest_param=largest,
+        per_device_hbm=_device_hbm(),
+    )
+
+
+def fits_in_hbm(
+    analysis: AnalysisResult, fsdp_size: int, tensor_size: int,
+    remat: bool, activation_factor: float = 4.0,
+) -> bool:
+    """Rough memory feasibility check for a candidate plan (the role
+    of the reference's dryrun memory profiling, cheaper)."""
+    shard = max(1, fsdp_size * tensor_size)
+    state = analysis.model_state_bytes() / shard
+    act = analysis.batch_bytes * activation_factor
+    if remat:
+        act *= 0.35
+    headroom = 0.9 * analysis.per_device_hbm
+    return state + act < headroom

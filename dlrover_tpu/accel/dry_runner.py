@@ -1,0 +1,67 @@
+"""Dry runner: profile a candidate plan with a real compiled step.
+
+Reference: ``dry_runner/dry_runner.py`` (``atorch/auto/``) profiles N
+training steps for throughput/memory.  The TPU version jits the
+sharded train step for the plan's mesh and times ``profile_steps``
+executions with ``block_until_ready``.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class DryRunResult:
+    ok: bool = False
+    step_time_s: float = 0.0
+    compile_time_s: float = 0.0
+    error: str = ""
+    device_peak_bytes: int = 0
+
+    @property
+    def steps_per_second(self) -> float:
+        return 1.0 / self.step_time_s if self.step_time_s else 0.0
+
+
+def profile_plan(
+    plan, context, profile_steps: int = 3
+) -> DryRunResult:
+    """Build + run the plan's train step on the current devices."""
+    from dlrover_tpu.accel.accelerate import build_from_plan
+
+    try:
+        built = build_from_plan(plan, context)
+    except Exception as e:  # noqa: BLE001 - any build error fails cand.
+        logger.info("plan build failed: %s", e)
+        return DryRunResult(ok=False, error=str(e))
+
+    state, batch, step = built.state, built.place_batch(
+        context.sample_batch
+    ), built.train_step
+    try:
+        t0 = time.perf_counter()
+        state, metrics = step(state, batch)
+        jax.block_until_ready(metrics)
+        compile_time = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(profile_steps):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics)
+        step_time = (time.perf_counter() - t0) / profile_steps
+    except Exception as e:  # noqa: BLE001
+        logger.info("plan execution failed: %s", e)
+        return DryRunResult(ok=False, error=str(e))
+
+    peak = 0
+    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+    if stats:
+        peak = int(stats.get("peak_bytes_in_use", 0))
+    return DryRunResult(
+        ok=True, step_time_s=step_time, compile_time_s=compile_time,
+        device_peak_bytes=peak,
+    )

@@ -1,0 +1,39 @@
+"""ModelContext: everything the strategy engine needs about a job.
+
+Reference: ``ModelContext`` (``atorch/auto/model_context.py``) carries
+model/optim/dataloader/loss + wrapper registry.  The JAX version is
+functional: a model-apply fn (or flax module), an optax-optimizer
+factory, a loss fn and a sample batch — enough to init params, build
+a train step, and dry-run candidates.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+@dataclass
+class ModelContext:
+    model: Any                              # flax module with .apply/.init
+    optim_factory: Callable[..., Any]       # () -> optax optimizer
+    loss_fn: Callable                       # (params, batch) -> scalar
+    sample_batch: Any                       # pytree of arrays
+    model_config: Any = None                # e.g. GPTConfig, for analysis
+    init_rng_seed: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+    _params: Any = None
+
+    def init_params(self):
+        if self._params is None:
+            rng = jax.random.PRNGKey(self.init_rng_seed)
+            if hasattr(self.model, "init_params"):
+                self._params = self.model.init_params(rng)
+            else:
+                self._params = self.model.init(rng, self.sample_batch)[
+                    "params"
+                ]
+        return self._params
+
+    def optimizer(self):
+        return self.optim_factory()

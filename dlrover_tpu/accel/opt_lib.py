@@ -1,0 +1,250 @@
+"""Optimization library: named methods that transform an AccelPlan.
+
+Reference: ``OptimizationLibrary`` with 15 registered methods
+(``atorch/auto/opt_lib/optimization_library.py:18``; zero1/zero2/fsdp/
+parallel_mode/amp_native/tensor_parallel/module_replace/checkpoint/
+pipeline_parallel/mixed_parallel/sequence_parallel/half/...).  Each
+torch method wraps modules; each TPU method *edits the plan*: mesh
+axis sizes, partition rules, remat, dtype, attention impl.  GSPMD does
+the rest at jit time.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+from dlrover_tpu.accel.strategy import AccelPlan
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.mesh import MeshConfig
+from dlrover_tpu.parallel.sharding import (
+    PartitionRules,
+    fsdp_rules,
+    gpt_tp_rules,
+    moe_rules,
+    replicated_rules,
+)
+
+
+class Optimization:
+    name = "base"
+    # mirrors the reference's SEMIAUTO_STRATEGIES: these need a config
+    # (axis size etc.) rather than being freely combinable
+    semiauto = False
+
+    def apply(self, plan: AccelPlan, config: Dict[str, Any],
+              context=None) -> AccelPlan:
+        raise NotImplementedError
+
+
+class ParallelModeOpt(Optimization):
+    """Pure data parallelism (torch DDP parity)."""
+
+    name = "parallel_mode"
+
+    def apply(self, plan, config, context=None):
+        plan.notes.append("data-parallel over the 'data' mesh axis")
+        return plan
+
+
+class Zero1Opt(Optimization):
+    """Optimizer-state sharding, params replicated (ZeRO-1/2 parity:
+    reference zero_optimization.py:115,158 — on TPU both reduce to
+    sharding the optimizer state over the fsdp axis; gradient
+    sharding is XLA's choice once outputs are sharded)."""
+
+    name = "zero1"
+
+    def apply(self, plan, config, context=None):
+        size = int(config.get("size", 0)) or None
+        if size:
+            plan.mesh_config.fsdp = size
+        elif plan.mesh_config.fsdp == 1:
+            plan.mesh_config.fsdp = -1  # absorb remaining devices
+            plan.mesh_config.data = 1
+        plan.opt_state_rules = fsdp_rules()
+        plan.notes.append("optimizer state sharded over 'fsdp'")
+        return plan
+
+
+class Zero2Opt(Zero1Opt):
+    name = "zero2"
+
+
+class FSDPOpt(Optimization):
+    """Parameter + optimizer-state sharding (ZeRO-3 / torch FSDP
+    parity: zero_optimization.py:240)."""
+
+    name = "fsdp"
+
+    def apply(self, plan, config, context=None):
+        size = int(config.get("size", 0)) or None
+        if size:
+            plan.mesh_config.fsdp = size
+        elif plan.mesh_config.fsdp == 1:
+            plan.mesh_config.fsdp = -1
+            plan.mesh_config.data = 1
+        plan.param_rules = fsdp_rules()
+        plan.opt_state_rules = None  # follow params
+        plan.notes.append("params+opt state sharded over 'fsdp'")
+        return plan
+
+
+class TensorParallelOpt(Optimization):
+    """Megatron-style TP via partition rules (reference:
+    tensor_parallel_optimization.py + distributed_modules/layers)."""
+
+    name = "tensor_parallel"
+    semiauto = True
+
+    def apply(self, plan, config, context=None):
+        plan.mesh_config.tensor = int(config.get("size", 2))
+        plan.param_rules = gpt_tp_rules()
+        plan.notes.append(
+            f"tensor parallel x{plan.mesh_config.tensor}"
+        )
+        return plan
+
+
+class SequenceParallelOpt(Optimization):
+    """Ulysses SP / ring CP over the 'sequence' axis (reference:
+    sequence_parallel_optimization.py; ring is the TPU extension)."""
+
+    name = "sequence_parallel"
+    semiauto = True
+
+    def apply(self, plan, config, context=None):
+        plan.mesh_config.sequence = int(config.get("size", 2))
+        plan.sequence_parallel = config.get("mode", "ulysses")
+        plan.notes.append(
+            f"sequence parallel ({plan.sequence_parallel}) "
+            f"x{plan.mesh_config.sequence}"
+        )
+        return plan
+
+
+class ExpertParallelOpt(Optimization):
+    """MoE expert parallelism (reference: moe_layer.py)."""
+
+    name = "expert_parallel"
+    semiauto = True
+
+    def apply(self, plan, config, context=None):
+        plan.mesh_config.expert = int(config.get("size", 2))
+        plan.param_rules = moe_rules()
+        plan.notes.append(
+            f"expert parallel x{plan.mesh_config.expert}"
+        )
+        return plan
+
+
+class MixedParallelOpt(Optimization):
+    """TP x FSDP x DP in one mesh (reference:
+    mixed_parallel_optimization.py:32)."""
+
+    name = "mixed_parallel"
+    semiauto = True
+
+    def apply(self, plan, config, context=None):
+        mc = plan.mesh_config
+        mc.tensor = int(config.get("tensor", 1))
+        mc.fsdp = int(config.get("fsdp", 1))
+        mc.sequence = int(config.get("sequence", 1))
+        mc.expert = int(config.get("expert", 1))
+        mc.data = int(config.get("data", -1))
+        plan.param_rules = (
+            moe_rules() if mc.expert > 1 else gpt_tp_rules()
+        )
+        plan.notes.append(f"mixed parallel {mc}")
+        return plan
+
+
+class AmpNativeOpt(Optimization):
+    """bf16 compute policy (reference amp_optimization.py; on TPU bf16
+    is the native MXU dtype, no grad scaler needed)."""
+
+    name = "amp_native"
+
+    def apply(self, plan, config, context=None):
+        plan.compute_dtype = config.get("dtype", "bfloat16")
+        plan.notes.append(f"compute dtype {plan.compute_dtype}")
+        return plan
+
+
+class HalfOpt(AmpNativeOpt):
+    name = "half"
+
+
+class CheckpointOpt(Optimization):
+    """Activation rematerialization (reference:
+    checkpoint_optimization.py -> jax.checkpoint per block)."""
+
+    name = "checkpoint"
+
+    def apply(self, plan, config, context=None):
+        plan.remat = True
+        plan.notes.append("activation remat per block")
+        return plan
+
+
+class ModuleReplaceOpt(Optimization):
+    """Kernel swap-in: flash attention (reference:
+    module_replace_optimization.py swapping HF attention for
+    FlashAttnModule)."""
+
+    name = "module_replace"
+
+    def apply(self, plan, config, context=None):
+        plan.attention_impl = config.get("attention", "flash")
+        plan.notes.append(f"attention impl {plan.attention_impl}")
+        return plan
+
+
+class PipelineParallelOpt(Optimization):
+    """Pipeline stages over the 'pipeline' axis.  Low priority on TPU
+    (SURVEY.md §7 hard parts): GSPMD usually wins; kept for mesh
+    completeness."""
+
+    name = "pipeline_parallel"
+    semiauto = True
+
+    def apply(self, plan, config, context=None):
+        plan.mesh_config.pipeline = int(config.get("size", 2))
+        plan.notes.append(
+            f"pipeline x{plan.mesh_config.pipeline} (collective-"
+            "permute microbatching)"
+        )
+        return plan
+
+
+class OptimizationLibrary:
+    """Name -> Optimization registry (reference:
+    optimization_library.py:18,40)."""
+
+    def __init__(self):
+        self._opts: Dict[str, Optimization] = {}
+        for cls in (
+            ParallelModeOpt, Zero1Opt, Zero2Opt, FSDPOpt,
+            TensorParallelOpt, SequenceParallelOpt, ExpertParallelOpt,
+            MixedParallelOpt, AmpNativeOpt, HalfOpt, CheckpointOpt,
+            ModuleReplaceOpt, PipelineParallelOpt,
+        ):
+            self.register(cls())
+
+    def register(self, opt: Optimization):
+        self._opts[opt.name] = opt
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._opts
+
+    def __getitem__(self, name: str) -> Optimization:
+        return self._opts[name]
+
+    def names(self):
+        return sorted(self._opts)
+
+    def apply_strategy(self, strategy, context=None) -> AccelPlan:
+        plan = AccelPlan()
+        for name, config in strategy.opts:
+            if name not in self._opts:
+                logger.warning("unknown optimization %s; skipping", name)
+                continue
+            plan = self._opts[name].apply(plan, config or {}, context)
+        return plan

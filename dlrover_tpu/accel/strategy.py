@@ -1,0 +1,67 @@
+"""Strategy and acceleration-plan data model.
+
+Reference: ATorch's ``Strategy`` — an ordered list of
+``(opt_name, config, tunable)`` applied by ``model_transform``
+(``atorch/auto/accelerate.py:34,406``).  Here the application target
+is an :class:`AccelPlan`: the declarative sharding/compile bundle a
+strategy's optimizations emit, which ``auto_accelerate`` turns into a
+jitted sharded train step.
+"""
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from dlrover_tpu.parallel.mesh import MeshConfig
+from dlrover_tpu.parallel.sharding import PartitionRules, replicated_rules
+
+
+@dataclass
+class AccelPlan:
+    """What a strategy compiles down to."""
+
+    mesh_config: MeshConfig = field(default_factory=MeshConfig)
+    # parameter + (optionally different) optimizer-state placement
+    param_rules: PartitionRules = field(default_factory=replicated_rules)
+    opt_state_rules: Optional[PartitionRules] = None
+    remat: bool = False
+    compute_dtype: str = "bfloat16"
+    attention_impl: str = "xla"
+    sequence_parallel: str = "none"  # none | ulysses | ring
+    grad_accum: int = 1
+    notes: List[str] = field(default_factory=list)
+
+    def effective_opt_rules(self) -> PartitionRules:
+        return (
+            self.opt_state_rules
+            if self.opt_state_rules is not None
+            else self.param_rules
+        )
+
+
+@dataclass
+class Strategy:
+    """Ordered (opt_name, config) pairs, JSON-serializable
+    (reference: strategy save/load, auto/accelerate.py:246,305)."""
+
+    opts: List[Tuple[str, Dict[str, Any]]] = field(default_factory=list)
+
+    def names(self) -> List[str]:
+        return [n for n, _ in self.opts]
+
+    def to_json(self) -> str:
+        return json.dumps({"opts": self.opts})
+
+    @classmethod
+    def from_json(cls, text: str) -> "Strategy":
+        data = json.loads(text)
+        return cls(opts=[(n, c) for n, c in data["opts"]])
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "Strategy":
+        with open(path) as f:
+            return cls.from_json(f.read())

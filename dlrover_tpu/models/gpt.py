@@ -43,6 +43,12 @@ class GPTConfig:
     # "xla" = dot-product attention lowered by XLA; "flash" = Pallas
     attention_impl: str = "xla"
     tie_embeddings: bool = True
+    # MoE: 0 = dense; >0 replaces the MLP of every ``moe_every``-th
+    # block with an expert-parallel MoEMLP (reference: moe_layer.py)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -137,6 +143,7 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     config: GPTConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -145,7 +152,22 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
         x = x + Attention(cfg, name="attn")(h.astype(cfg.dtype))
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-        x = x + MLP(cfg, name="mlp")(h.astype(cfg.dtype))
+        if self.use_moe:
+            from dlrover_tpu.parallel.moe import MoEMLP
+
+            mlp_out = MoEMLP(
+                num_experts=cfg.moe_experts,
+                hidden_dim=cfg.hidden_dim,
+                mlp_dim=cfg.mlp_ratio * cfg.hidden_dim,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="moe",
+            )(h.astype(cfg.dtype))
+        else:
+            mlp_out = MLP(cfg, name="mlp")(h.astype(cfg.dtype))
+        x = x + mlp_out
         return x
 
 
@@ -169,7 +191,10 @@ class GPT(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x)
+            use_moe = (
+                cfg.moe_experts > 0 and i % cfg.moe_every == 1
+            )
+            x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if cfg.tie_embeddings:
             logits = wte.attend(x.astype(cfg.dtype))

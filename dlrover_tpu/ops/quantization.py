@@ -1,0 +1,76 @@
+"""Block-wise int8 quantization kernels (Pallas) for optimizer state.
+
+Reference: ATorch's CUDA quantization kernels powering the low-bit
+optimizer family (``atorch/atorch/ops/csrc/quantization/{quantize,
+dequantize,quantization_optimizer}.cu``, ~4.6k LoC; SURVEY.md §2.7).
+TPU equivalent: symmetric absmax int8 with one fp32 scale per block of
+``block_size`` elements, as Pallas kernels (interpret mode on CPU).
+Used by :mod:`dlrover_tpu.optim.low_bit` to store Adam moments in 1/4
+the HBM.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 2048  # elements per scale block (multiple of 128 lanes)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _quant_kernel(x_ref, q_ref, scale_ref):
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)  # [rows, 1]
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    q_ref[:] = q
+    scale_ref[:] = scale
+
+
+def _dequant_kernel(q_ref, scale_ref, out_ref):
+    out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[:]
+
+
+def quantize_blockwise(
+    x: jax.Array, block_size: int = DEFAULT_BLOCK
+) -> Tuple[jax.Array, jax.Array, Tuple[int, ...]]:
+    """Flatten + pad to [rows, block_size]; returns (int8 values,
+    fp32 scales [rows, 1], original shape)."""
+    shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.size
+    rows = -(-n // block_size)
+    pad = rows * block_size - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    tiles = flat.reshape(rows, block_size)
+
+    q, scales = pl.pallas_call(
+        _quant_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, block_size), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(tiles)
+    return q, scales, shape
+
+
+def dequantize_blockwise(
+    q: jax.Array, scales: jax.Array, shape: Tuple[int, ...]
+) -> jax.Array:
+    out = pl.pallas_call(
+        _dequant_kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+        interpret=_interpret(),
+    )(q, scales)
+    n = 1
+    for s in shape:
+        n *= s
+    return out.reshape(-1)[:n].reshape(shape)

@@ -1,0 +1,25 @@
+"""Optimizer zoo (optax-style gradient transformations).
+
+Reference: ``atorch/atorch/optimizers/`` — AGD (NeurIPS'23), WSAM
+(KDD'23), low-bit quantized-state optimizers, CPU-offload Adam — plus
+``local_sgd/`` (DiLoCo).  All rebuilt as pure-functional optax
+transforms; the low-bit family stores moments int8 via the Pallas
+kernels in :mod:`dlrover_tpu.ops.quantization`.
+"""
+
+from dlrover_tpu.optim.agd import agd
+from dlrover_tpu.optim.local_sgd import (
+    diloco_outer_step,
+    init_diloco,
+)
+from dlrover_tpu.optim.low_bit import q_adamw
+from dlrover_tpu.optim.wsam import sam_gradient, wsam
+
+__all__ = [
+    "agd",
+    "diloco_outer_step",
+    "init_diloco",
+    "q_adamw",
+    "sam_gradient",
+    "wsam",
+]

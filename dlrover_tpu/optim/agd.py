@@ -1,0 +1,81 @@
+"""AGD: auto-switchable optimizer using the stepwise gradient
+difference (Yue et al., NeurIPS 2023).
+
+Reference integration point: ``atorch/optimizers/agd.py:18`` (torch).
+Algorithm (from the paper, reimplemented functionally): the second
+moment accumulates the squared *difference* of successive gradients —
+an approximation of curvature — and the preconditioner
+``max(sqrt(v_hat), delta)`` auto-switches between adaptive behaviour
+(where curvature is informative) and SGD-like steps (where it is
+below ``delta``).
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AGDState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates       # first moment
+    nu: optax.Updates       # second moment of gradient differences
+    prev_grad: optax.Updates
+
+
+def agd(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    delta: float = 1e-5,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return AGDState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+            prev_grad=zeros,
+        )
+
+    def update_fn(grads, state, params=None):
+        count = state.count + 1
+        # first step: difference vs zero would overestimate; use g
+        diff = jax.tree.map(
+            lambda g, pg: jnp.where(count == 1, g, g - pg),
+            grads, state.prev_grad,
+        )
+        mu = jax.tree.map(
+            lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads
+        )
+        nu = jax.tree.map(
+            lambda v, d: b2 * v + (1 - b2) * d * d, state.nu, diff
+        )
+        bc1 = 1 - b1**count.astype(jnp.float32)
+        bc2 = 1 - b2**count.astype(jnp.float32)
+
+        def direction(m, v):
+            m_hat = m / bc1
+            v_hat = jnp.sqrt(v / bc2)
+            # auto-switch: adaptive where sqrt(v_hat) > delta,
+            # SGD-like (divide by delta) elsewhere
+            denom = jnp.maximum(v_hat, delta) + eps
+            return m_hat / denom
+
+        updates = jax.tree.map(direction, mu, nu)
+        if weight_decay:
+            updates = jax.tree.map(
+                lambda u, p: u + weight_decay * p, updates,
+                params if params is not None else updates,
+            )
+        updates = jax.tree.map(
+            lambda u: -learning_rate * u, updates
+        )
+        return updates, AGDState(
+            count=count, mu=mu, nu=nu, prev_grad=grads
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,57 @@
+"""WSAM: sharpness-aware minimization with a weighted sharpness term
+(Yue et al., KDD 2023).
+
+Reference integration point: ``atorch/optimizers/wsam.py:11`` (torch
+``WeightedSAM``).  SAM-family optimizers need two gradient
+evaluations per step (at ``w`` and at the perturbed ``w + e(w)``);
+in JAX that is a property of the *loss-gradient computation*, not the
+optimizer state, so this module provides:
+
+- :func:`sam_gradient` — computes the WSAM combined gradient
+  ``(1-gamma)*g + gamma*g_adv`` with ``e(w) = rho * g/||g||``;
+- :func:`wsam` — an optax transform applying any base optimizer to
+  that combined gradient (chain it after ``sam_gradient`` in the
+  train step).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def sam_gradient(
+    loss_fn: Callable,
+    params,
+    batch,
+    rho: float = 0.05,
+    gamma: float = 0.9,
+):
+    """Two-pass WSAM gradient.
+
+    gamma=0 -> vanilla gradient; gamma=1 -> pure SAM gradient;
+    in between, the sharpness term is weighted as in the paper:
+    ``g_wsam = (1-gamma) * g + gamma * g_adv``.
+    Returns (loss, combined_gradient).
+    """
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    gnorm = optax.global_norm(grads)
+    scale = rho / (gnorm + 1e-12)
+    perturbed = jax.tree.map(lambda p, g: p + scale * g, params, grads)
+    adv_grads = jax.value_and_grad(loss_fn)(perturbed, batch)[1]
+    combined = jax.tree.map(
+        lambda g, ga: (1.0 - gamma) * g + gamma * ga, grads, adv_grads
+    )
+    return loss, combined
+
+
+def wsam(
+    base: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 1e-3,
+) -> optax.GradientTransformation:
+    """Optax transform for WSAM: just the base optimizer — the
+    sharpness weighting happens in :func:`sam_gradient`.  Provided so
+    user code reads ``optimizer = wsam(optax.sgd(lr))`` the way the
+    reference reads ``WeightedSAM(base_optimizer=...)``."""
+    return base if base is not None else optax.sgd(learning_rate)

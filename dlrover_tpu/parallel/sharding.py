@@ -179,8 +179,8 @@ def moe_rules() -> PartitionRules:
     base = gpt_tp_rules()
     return base.extended(
         [
-            (r"experts/.*kernel$", ("expert", "fsdp", "tensor")),
-            (r"router/kernel$", ("fsdp", None)),
+            (r"experts_w_(in|out)$", ("expert", "fsdp", "tensor")),
+            (r"router/kernel$", (None, None)),
         ]
     )
 
