@@ -12,7 +12,8 @@ datasets consume.
 import queue
 import threading
 import time
-from typing import Callable, List, Optional
+from collections import deque
+from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.constants import TaskType
@@ -93,13 +94,18 @@ class IndexShardingClient(ShardingClient):
 
     def __init__(self, *args, prefetch_depth: int = 4096, **kwargs):
         super().__init__(*args, **kwargs)
-        self._index_queue: "queue.Queue[Optional[int]]" = queue.Queue(
+        self._index_queue: "queue.Queue[Optional[tuple]]" = queue.Queue(
             maxsize=prefetch_depth
         )
-        # Count of samples remaining in the shard currently being
-        # consumed; when it hits zero the shard is acked.
-        self._shard_remaining = 0
-        self._consuming_task_id = -1
+        # Delivery-order accounting: fetch_sample_index appends each
+        # delivered sample's task_id; report_batch_done pops in FIFO
+        # order and acks a task once all its samples are processed.
+        # (The prefetch thread runs far ahead of the consumer, so the
+        # "currently consumed shard" can only be derived from delivery
+        # order, never from the prefetch position.)
+        self._delivered: "deque[int]" = deque()
+        self._task_sizes: Dict[int, int] = {}
+        self._acked_counts: Dict[int, int] = {}
         self._consume_lock = threading.Lock()
         self._stopped = threading.Event()
         self._prefetch_thread = threading.Thread(
@@ -119,6 +125,8 @@ class IndexShardingClient(ShardingClient):
                     if task.indices is not None
                     else list(range(task.start, task.end))
                 )
+                with self._consume_lock:
+                    self._task_sizes[task.task_id] = len(indices)
                 for idx in indices:
                     self._index_queue.put((task.task_id, idx))
         except Exception as e:  # noqa: BLE001
@@ -132,28 +140,31 @@ class IndexShardingClient(ShardingClient):
             return None
         task_id, idx = item
         with self._consume_lock:
-            if task_id != self._consuming_task_id:
-                self._consuming_task_id = task_id
-                self._shard_remaining = self._shard_size(task_id)
+            self._delivered.append(task_id)
         return idx
 
-    def _shard_size(self, task_id: int) -> int:
-        with self._lock:
-            for t in self._pending:
-                if t.task_id == task_id:
-                    return t.shard_size
-        return 0
-
     def report_batch_done(self, batch_size: Optional[int] = None):
-        """Account consumed samples; ack the shard once fully consumed
-        (reference: client.py report_batch_done)."""
+        """Mark the next ``batch_size`` delivered samples processed;
+        ack each shard whose samples are all processed (reference:
+        client.py report_batch_done)."""
         consumed = batch_size or self.batch_size
+        to_ack = []
         with self._consume_lock:
-            self._shard_remaining -= consumed
-            if self._shard_remaining <= 0 and self._consuming_task_id >= 0:
-                done_id = self._consuming_task_id
-                self._consuming_task_id = -1
-                self.report_task_done(done_id)
+            for _ in range(consumed):
+                if not self._delivered:
+                    break
+                tid = self._delivered.popleft()
+                self._acked_counts[tid] = (
+                    self._acked_counts.get(tid, 0) + 1
+                )
+                if self._acked_counts[tid] >= self._task_sizes.get(
+                    tid, float("inf")
+                ):
+                    to_ack.append(tid)
+                    del self._acked_counts[tid]
+                    del self._task_sizes[tid]
+        for tid in to_ack:
+            self.report_task_done(tid)
 
     def stop(self):
         self._stopped.set()

@@ -1,0 +1,200 @@
+"""Distributed node manager: pod lifecycle on a cluster scheduler.
+
+Reference: ``DistributedJobManager`` (``dlrover/python/master/node/
+dist_job_manager.py:88,181,334,561,605``): initializes the node set
+from JobArgs, scales the initial plan, processes watcher events
+through the status FSM, decides relaunch-vs-abort per exit reason and
+restart budget, and emits replacement nodes via the scaler.  Extends
+the registry-level :class:`dlrover_tpu.master.job_manager.JobManager`
+(heartbeats, event callbacks, failure handling).
+"""
+
+import itertools
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeEvent, new_worker
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.scaler import ScalePlan, Scaler
+from dlrover_tpu.master.status_flow import apply_transition
+from dlrover_tpu.master.watcher import PodWatcher
+from dlrover_tpu.scheduler.job_args import JobArgs
+
+
+class DistributedJobManager(JobManager):
+    def __init__(
+        self,
+        job_args: JobArgs,
+        scaler: Scaler,
+        watcher: Optional[PodWatcher] = None,
+        error_monitor=None,
+    ):
+        super().__init__(error_monitor=error_monitor)
+        self._job_args = job_args
+        self._scaler = scaler
+        self._watcher = watcher
+        self._id_iter = itertools.count(job_args.worker_count())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._init_nodes()
+        self._scaler.start()
+        self._scaler.scale(self._initial_plan())
+        if self._watcher is not None:
+            self._watcher.start()
+        self.start_heartbeat_monitor()
+
+    def stop(self):
+        if self._watcher is not None:
+            self._watcher.stop()
+        self._scaler.stop()
+        super().stop()
+
+    def _init_nodes(self):
+        worker_args = self._job_args.node_args.get(NodeType.WORKER)
+        if worker_args is None:
+            return
+        for i in range(worker_args.group_resource.count):
+            node = self.add_node(NodeType.WORKER, i, rank=i)
+            node.config_resource = worker_args.group_resource.node_resource
+            node.max_relaunch_count = worker_args.restart_count
+
+    def _initial_plan(self) -> ScalePlan:
+        plan = ScalePlan()
+        plan.launch_nodes = [
+            n for n in self.all_nodes().values()
+            if n.status == NodeStatus.INITIAL
+        ]
+        return plan
+
+    # -- event processing --------------------------------------------------
+
+    def process_event(self, event: NodeEvent):
+        """Watcher callback (reference: _process_event,
+        dist_job_manager.py:473)."""
+        node = self.get_node(event.node.id)
+        if node is None:
+            node = self.add_node(event.node.type, event.node.id,
+                                 event.node.rank_index)
+        if event.node.host_ip:
+            node.host_ip = event.node.host_ip
+        new_status = event.node.status
+        if event.event_type == NodeEventType.DELETED:
+            new_status = NodeStatus.DELETED
+        changed = apply_transition(node, new_status)
+        if not changed:
+            return
+        node.exit_reason = event.node.exit_reason
+        logger.info(
+            "node %s -> %s (%s)", node.id, node.status,
+            node.exit_reason or "-",
+        )
+        for cb in self._event_callbacks:
+            try:
+                cb(NodeEvent(event_type=event.event_type, node=node))
+            except Exception:  # noqa: BLE001
+                logger.exception("node event callback failed")
+        if node.status in (NodeStatus.FAILED, NodeStatus.DELETED):
+            self._handle_node_exit(node)
+
+    def _handle_node_exit(self, node: Node):
+        if self._should_relaunch(node):
+            self._relaunch_node(node)
+        elif node.critical or self._all_relaunches_exhausted():
+            self.job_exit_reason = node.exit_reason or "node_failed"
+
+    def _should_relaunch(self, node: Node) -> bool:
+        """Reference: _should_relaunch, dist_job_manager.py:561."""
+        if not node.relaunchable or node.is_released:
+            return False
+        if node.exit_reason == NodeExitReason.FATAL_ERROR:
+            # code errors don't heal by relaunching
+            return False
+        if node.exceeded_max_relaunch():
+            return False
+        return node.exit_reason in (
+            NodeExitReason.KILLED,
+            NodeExitReason.OOM,
+            NodeExitReason.PREEMPTED,
+            NodeExitReason.HARDWARE_ERROR,
+            NodeExitReason.UNKNOWN,
+            "",
+        )
+
+    def _all_relaunches_exhausted(self) -> bool:
+        return all(
+            n.exceeded_max_relaunch()
+            for n in self.all_nodes().values()
+            if n.status in NodeStatus.end_states()
+        )
+
+    def _relaunch_node(self, node: Node):
+        """Reference: _relaunch_node, dist_job_manager.py:605 — a new
+        node id replaces the dead one at the same rank."""
+        node.inc_relaunch_count()
+        node.is_released = True
+        new_id = next(self._id_iter)
+        replacement = new_worker(new_id, rank=node.rank_index)
+        replacement.config_resource = node.config_resource
+        replacement.relaunch_count = node.relaunch_count
+        replacement.max_relaunch_count = node.max_relaunch_count
+        with self._lock:
+            self._nodes[new_id] = replacement
+        if node.exit_reason == NodeExitReason.OOM:
+            # bump memory on OOM (reference: job.py OOM adjustment)
+            replacement.config_resource.memory_mb *= 1.5
+        logger.info(
+            "relaunching node %s as %s (attempt %s/%s)",
+            node.id, new_id, node.relaunch_count,
+            node.max_relaunch_count,
+        )
+        plan = ScalePlan(
+            launch_nodes=[replacement], remove_nodes=[node]
+        )
+        self._scaler.scale(plan)
+
+    # -- scaling (used by the auto-scaler) ---------------------------------
+
+    def adjust_worker_count(self, target: int) -> ScalePlan:
+        """Grow/shrink the worker group to ``target`` (reference:
+        AllreduceTrainingAutoScaler execution path)."""
+        plan = ScalePlan()
+        alive = [
+            n for n in self.all_nodes().values()
+            if n.type == NodeType.WORKER and n.is_alive()
+            and not n.is_released
+        ]
+        if target > len(alive):
+            for _ in range(target - len(alive)):
+                new_id = next(self._id_iter)
+                node = new_worker(new_id, rank=new_id)
+                worker_args = self._job_args.node_args.get(
+                    NodeType.WORKER
+                )
+                if worker_args:
+                    node.config_resource = (
+                        worker_args.group_resource.node_resource
+                    )
+                with self._lock:
+                    self._nodes[new_id] = node
+                plan.launch_nodes.append(node)
+        elif target < len(alive):
+            doomed = sorted(alive, key=lambda n: -n.rank_index)[
+                : len(alive) - target
+            ]
+            for node in doomed:
+                node.relaunchable = False
+                node.is_released = True
+                plan.remove_nodes.append(node)
+        if not plan.empty():
+            self._scaler.scale(plan)
+        return plan

@@ -1,0 +1,117 @@
+"""Pod event watching -> NodeEvents.
+
+Reference: ``PodWatcher`` (``dlrover/python/master/watcher/
+k8s_watcher.py:194``) with exit-reason classification
+(``k8s_watcher.py:52``): list+watch pods of the job, map phases to
+node statuses, classify failures (OOMKilled/evicted/preempted) so the
+relaunch policy can distinguish hardware faults from code errors.
+"""
+
+import threading
+from typing import Callable, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeEvent
+from dlrover_tpu.scheduler.kubernetes import (
+    K8sClient,
+    pod_status_to_node_status,
+)
+
+
+def classify_exit_reason(pod: dict) -> str:
+    """Reference: exit-reason classification, k8s_watcher.py:52."""
+    status = pod.get("status", {})
+    reason = str(status.get("reason", ""))
+    exit_code = int(status.get("container_exit_code", 0) or 0)
+    if reason in ("OOMKilled",):
+        return NodeExitReason.OOM
+    if reason in ("Evicted", "Preempted", "Deleted"):
+        return NodeExitReason.PREEMPTED
+    if exit_code in (137, 143):
+        return NodeExitReason.KILLED
+    if exit_code == 201 or reason == "HardwareError":
+        return NodeExitReason.HARDWARE_ERROR
+    if exit_code != 0:
+        return NodeExitReason.FATAL_ERROR
+    return NodeExitReason.SUCCEEDED
+
+
+def pod_to_node(pod: dict) -> Optional[Node]:
+    labels = pod.get("metadata", {}).get("labels", {})
+    if "node-id" not in labels:
+        return None
+    node = Node(
+        type=labels.get("node-type", "worker"),
+        id=int(labels["node-id"]),
+        rank_index=int(labels.get("rank", labels["node-id"])),
+        name=pod.get("metadata", {}).get("name", ""),
+        status=pod_status_to_node_status(
+            pod.get("status", {}).get("phase", "Unknown")
+        ),
+        host_ip=pod.get("status", {}).get("host_ip", ""),
+    )
+    if node.status == NodeStatus.FAILED:
+        node.exit_reason = classify_exit_reason(pod)
+    return node
+
+
+class PodWatcher:
+    """Feeds NodeEvents to a callback from k8s watch events."""
+
+    def __init__(
+        self,
+        job_name: str,
+        client: K8sClient,
+        event_handler: Callable[[NodeEvent], None],
+    ):
+        self._job_name = job_name
+        self._client = client
+        self._handler = event_handler
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def label_selector(self) -> str:
+        return f"app=dlrover-tpu,job={self._job_name}"
+
+    def list_nodes(self) -> List[Node]:
+        nodes = []
+        for pod in self._client.list_pods(self.label_selector):
+            node = pod_to_node(pod)
+            if node is not None:
+                nodes.append(node)
+        return nodes
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch, daemon=True, name="pod-watcher"
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _watch(self):
+        while not self._stop.is_set():
+            try:
+                for etype, pod in self._client.watch_pods(
+                    self.label_selector
+                ):
+                    if self._stop.is_set():
+                        return
+                    node = pod_to_node(pod)
+                    if node is None:
+                        continue
+                    self._handler(
+                        NodeEvent(event_type=etype, node=node)
+                    )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("pod watch error: %s; rewatching", e)
+            if not self._stop.wait(1.0):
+                continue

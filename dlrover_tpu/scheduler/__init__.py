@@ -1,0 +1,7 @@
+"""Cluster substrate clients (reference: ``dlrover/python/scheduler/``
+— k8sClient, RayClient, JobArgs per platform)."""
+
+from dlrover_tpu.scheduler.job_args import JobArgs, NodeArgs, new_job_args
+from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+__all__ = ["JobArgs", "K8sClient", "NodeArgs", "new_job_args"]

@@ -1,0 +1,223 @@
+"""Kubernetes client wrapper.
+
+Reference: ``k8sClient`` (``dlrover/python/scheduler/kubernetes.py:121``)
+— a thin facade over the official client (create/get/delete pods,
+patch CRs, watch) that the scaler/watcher layers consume.  The real
+``kubernetes`` package is optional (absent on TPU-VM test images);
+tests inject :class:`MockK8sApi`, mirroring the reference's
+``mock_k8s_client`` fixture (test_utils.py:268).
+
+Pods here are plain dicts shaped like V1Pod manifests — the TPU
+deployment story runs the agent per TPU-VM host in a GKE pod.
+"""
+
+import threading
+import time
+from queue import Empty, Queue
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.log import default_logger as logger
+
+_POD_STATUS_MAP = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.UNKNOWN,
+}
+
+
+def pod_status_to_node_status(phase: str) -> str:
+    return _POD_STATUS_MAP.get(phase, NodeStatus.UNKNOWN)
+
+
+class K8sApi:
+    """Interface the real/mock API objects implement."""
+
+    def create_pod(self, namespace: str, body: Dict) -> bool:
+        raise NotImplementedError
+
+    def delete_pod(self, namespace: str, name: str) -> bool:
+        raise NotImplementedError
+
+    def list_pods(self, namespace: str, label_selector: str) -> List[Dict]:
+        raise NotImplementedError
+
+    def patch_custom_resource(
+        self, group: str, version: str, namespace: str, plural: str,
+        name: str, body: Dict,
+    ) -> bool:
+        raise NotImplementedError
+
+    def create_custom_resource(
+        self, group: str, version: str, namespace: str, plural: str,
+        body: Dict,
+    ) -> bool:
+        raise NotImplementedError
+
+    def watch_pods(self, namespace: str, label_selector: str):
+        """Yield (event_type, pod_dict) tuples; blocks."""
+        raise NotImplementedError
+
+
+class RealK8sApi(K8sApi):  # pragma: no cover - needs a cluster
+    """Official-client backing; only importable inside a cluster."""
+
+    def __init__(self):
+        from kubernetes import client, config, watch
+
+        try:
+            config.load_incluster_config()
+        except Exception:  # noqa: BLE001
+            config.load_kube_config()
+        self._core = client.CoreV1Api()
+        self._custom = client.CustomObjectsApi()
+        self._watch_mod = watch
+
+    def create_pod(self, namespace, body):
+        self._core.create_namespaced_pod(namespace, body)
+        return True
+
+    def delete_pod(self, namespace, name):
+        self._core.delete_namespaced_pod(name, namespace)
+        return True
+
+    def list_pods(self, namespace, label_selector):
+        pods = self._core.list_namespaced_pod(
+            namespace, label_selector=label_selector
+        )
+        return [p.to_dict() for p in pods.items]
+
+    def patch_custom_resource(self, group, version, namespace, plural,
+                              name, body):
+        self._custom.patch_namespaced_custom_object(
+            group, version, namespace, plural, name, body
+        )
+        return True
+
+    def create_custom_resource(self, group, version, namespace, plural,
+                               body):
+        self._custom.create_namespaced_custom_object(
+            group, version, namespace, plural, body
+        )
+        return True
+
+    def watch_pods(self, namespace, label_selector):
+        w = self._watch_mod.Watch()
+        for event in w.stream(
+            self._core.list_namespaced_pod, namespace,
+            label_selector=label_selector,
+        ):
+            yield event["type"].lower(), event["object"].to_dict()
+
+
+class MockK8sApi(K8sApi):
+    """In-memory cluster for tests (reference: mock_k8s_client)."""
+
+    def __init__(self):
+        self.pods: Dict[str, Dict] = {}
+        self.custom_resources: Dict[str, Dict] = {}
+        self._events: "Queue[tuple]" = Queue()
+        self.create_calls = 0
+        self.delete_calls = 0
+
+    def create_pod(self, namespace, body):
+        name = body["metadata"]["name"]
+        body.setdefault("status", {})["phase"] = "Pending"
+        self.pods[name] = body
+        self.create_calls += 1
+        self._events.put(("added", dict(body)))
+        return True
+
+    def delete_pod(self, namespace, name):
+        pod = self.pods.pop(name, None)
+        self.delete_calls += 1
+        if pod is not None:
+            pod.setdefault("status", {})["phase"] = "Failed"
+            pod["status"]["reason"] = "Deleted"
+            self._events.put(("deleted", dict(pod)))
+        return True
+
+    def set_pod_phase(self, name: str, phase: str, reason: str = "",
+                      exit_code: int = 0):
+        pod = self.pods.get(name)
+        if pod is None:
+            return
+        pod.setdefault("status", {})["phase"] = phase
+        if reason:
+            pod["status"]["reason"] = reason
+        if exit_code:
+            pod["status"]["container_exit_code"] = exit_code
+        self._events.put(("modified", dict(pod)))
+
+    def list_pods(self, namespace, label_selector):
+        return list(self.pods.values())
+
+    def patch_custom_resource(self, group, version, namespace, plural,
+                              name, body):
+        self.custom_resources[f"{plural}/{name}"] = body
+        return True
+
+    def create_custom_resource(self, group, version, namespace, plural,
+                               body):
+        name = body.get("metadata", {}).get("name", "unnamed")
+        self.custom_resources[f"{plural}/{name}"] = body
+        return True
+
+    def watch_pods(self, namespace, label_selector):
+        while True:
+            try:
+                yield self._events.get(timeout=1.0)
+            except Empty:
+                return
+
+
+class K8sClient:
+    """Facade used by scalers/watchers (reference: k8sClient:121)."""
+
+    _singleton: Optional["K8sClient"] = None
+
+    def __init__(self, namespace: str = "default",
+                 api: Optional[K8sApi] = None):
+        self.namespace = namespace
+        self.api = api or RealK8sApi()
+
+    @classmethod
+    def singleton(cls, namespace: str = "default",
+                  api: Optional[K8sApi] = None) -> "K8sClient":
+        if cls._singleton is None:
+            cls._singleton = cls(namespace, api)
+        return cls._singleton
+
+    @classmethod
+    def reset(cls):
+        cls._singleton = None
+
+    def create_pod(self, body: Dict) -> bool:
+        try:
+            return self.api.create_pod(self.namespace, body)
+        except Exception as e:  # noqa: BLE001
+            logger.error("create_pod failed: %s", e)
+            return False
+
+    def delete_pod(self, name: str) -> bool:
+        try:
+            return self.api.delete_pod(self.namespace, name)
+        except Exception as e:  # noqa: BLE001
+            logger.error("delete_pod failed: %s", e)
+            return False
+
+    def list_pods(self, label_selector: str = "") -> List[Dict]:
+        return self.api.list_pods(self.namespace, label_selector)
+
+    def watch_pods(self, label_selector: str = ""):
+        return self.api.watch_pods(self.namespace, label_selector)
+
+    def apply_scale_plan_cr(self, name: str, body: Dict) -> bool:
+        """Write a ScalePlan custom resource for the operator
+        (reference: ElasticJobScaler -> ScalePlan CRD)."""
+        return self.api.create_custom_resource(
+            "elastic.dlrover-tpu.org", "v1alpha1", self.namespace,
+            "scaleplans", body,
+        )

@@ -1,0 +1,152 @@
+"""Elastic dataset + device-feeding loader.
+
+Reference: ``ElasticDataset`` (``atorch/data/elastic_dataset.py:19``)
+— a dataset whose sample indices come from the master's dynamic
+sharding service, so a resized/restarted job never re-reads completed
+shards — and ``ElasticDataLoader`` (``dlrover/trainer/torch/elastic/
+dataloader.py:26``) whose batch size follows the runtime parallelism
+config.  The TPU loader assembles numpy batches and device_puts them
+with the mesh's batch sharding, with a one-batch prefetch so host
+assembly overlaps device compute.
+"""
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.agent.sharding_client import IndexShardingClient
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ElasticDataset:
+    """Map-style dataset over master-assigned sample indices.
+
+    Subclass and implement ``read_sample(index)`` (reference API
+    parity: elastic_dataset.py ``ElasticDataset.read_sample``), or
+    pass ``read_fn``.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        batch_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        read_fn: Optional[Callable[[int], Any]] = None,
+        sharding_client: Optional[IndexShardingClient] = None,
+    ):
+        self.dataset_size = dataset_size
+        self.batch_size = batch_size
+        self._read_fn = read_fn
+        self._client = sharding_client or IndexShardingClient(
+            dataset_name=dataset_name,
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            dataset_size=dataset_size,
+            shuffle=shuffle,
+        )
+
+    def read_sample(self, index: int):
+        if self._read_fn is None:
+            raise NotImplementedError(
+                "implement read_sample or pass read_fn"
+            )
+        return self._read_fn(index)
+
+    def __len__(self) -> int:
+        return self.dataset_size
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            idx = self._client.fetch_sample_index()
+            if idx is None:
+                return
+            yield self.read_sample(idx)
+
+    def report_batch_done(self, batch_size: Optional[int] = None):
+        self._client.report_batch_done(batch_size)
+
+    def checkpoint(self) -> str:
+        return self._client.get_checkpoint()
+
+    def restore_checkpoint(self, content: str):
+        self._client.restore_checkpoint(content)
+
+
+class ElasticDataLoader:
+    """Batches an ElasticDataset and feeds the device mesh."""
+
+    def __init__(
+        self,
+        dataset: ElasticDataset,
+        batch_size: Optional[int] = None,
+        collate_fn: Optional[Callable] = None,
+        mesh=None,
+        prefetch: int = 2,
+        drop_last: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size or dataset.batch_size
+        self._collate = collate_fn or _default_collate
+        self._mesh = mesh
+        self._prefetch = prefetch
+        self._drop_last = drop_last
+
+    def set_batch_size(self, batch_size: int):
+        """Runtime-tunable batch size (reference: ElasticDataLoader
+        reloading from the paral-config file)."""
+        self.batch_size = batch_size
+
+    def _place(self, batch):
+        if self._mesh is None:
+            return batch
+        import jax
+        from jax.sharding import NamedSharding
+
+        from dlrover_tpu.parallel.sharding import batch_spec
+
+        return jax.device_put(
+            batch, NamedSharding(self._mesh, batch_spec())
+        )
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
+        DONE = object()
+
+        def producer():
+            samples = []
+            try:
+                for sample in self.dataset:
+                    samples.append(sample)
+                    if len(samples) == self.batch_size:
+                        q.put(self._collate(samples))
+                        samples = []
+                if samples and not self._drop_last:
+                    q.put(self._collate(samples))
+            except Exception as e:  # noqa: BLE001
+                logger.error("dataloader producer failed: %s", e)
+            finally:
+                q.put(DONE)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            yield self._place(item)
+            self.dataset.report_batch_done(self.batch_size)
+
+
+def _default_collate(samples):
+    """Stack dict-of-arrays or array samples into numpy batches."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {
+            k: np.stack([np.asarray(s[k]) for s in samples])
+            for k in first
+        }
+    return np.stack([np.asarray(s) for s in samples])

@@ -1,0 +1,204 @@
+"""Node management tests against the mock k8s API (the reference's
+mocked-k8s test pattern): scaler pod creation, watcher classification,
+status FSM, relaunch policy, OOM memory bump, auto-scaler."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import NodeEvent
+from dlrover_tpu.master.auto_scaler import AllreduceAutoScaler
+from dlrover_tpu.master.node_manager import DistributedJobManager
+from dlrover_tpu.master.resource_optimizer import LocalOptimizer
+from dlrover_tpu.master.scaler import ElasticJobScaler, PodScaler, ScalePlan
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.status_flow import can_transition
+from dlrover_tpu.master.watcher import (
+    PodWatcher,
+    classify_exit_reason,
+    pod_to_node,
+)
+from dlrover_tpu.scheduler.job_args import new_job_args
+from dlrover_tpu.scheduler.kubernetes import K8sClient, MockK8sApi
+
+
+@pytest.fixture()
+def k8s():
+    api = MockK8sApi()
+    return K8sClient(namespace="test", api=api), api
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _manager(client, num_workers=2):
+    args = new_job_args(
+        platform="kubernetes", job_name="tj", num_workers=num_workers
+    )
+    scaler = PodScaler("tj", client, master_addr="1.2.3.4:5")
+    mgr = DistributedJobManager(args, scaler)
+    watcher = PodWatcher("tj", client, mgr.process_event)
+    mgr._watcher = watcher
+    return mgr
+
+
+def test_status_flow_blocks_backwards():
+    assert can_transition(NodeStatus.PENDING, NodeStatus.RUNNING)
+    assert not can_transition(NodeStatus.RUNNING, NodeStatus.PENDING)
+    assert not can_transition(NodeStatus.SUCCEEDED, NodeStatus.RUNNING)
+
+
+def test_exit_reason_classification():
+    assert classify_exit_reason(
+        {"status": {"reason": "OOMKilled"}}
+    ) == NodeExitReason.OOM
+    assert classify_exit_reason(
+        {"status": {"reason": "Evicted"}}
+    ) == NodeExitReason.PREEMPTED
+    assert classify_exit_reason(
+        {"status": {"container_exit_code": 1}}
+    ) == NodeExitReason.FATAL_ERROR
+    assert classify_exit_reason(
+        {"status": {"container_exit_code": 137}}
+    ) == NodeExitReason.KILLED
+
+
+def test_initial_scale_creates_pods(k8s):
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: api.create_calls == 2)
+        assert "tj-worker-0" in api.pods and "tj-worker-1" in api.pods
+        # env contract present in the pod spec
+        env = api.pods["tj-worker-0"]["spec"]["containers"][0]["env"]
+        assert any(e["name"] == "DLROVER_MASTER_ADDR" for e in env)
+    finally:
+        mgr.stop()
+
+
+def test_pod_failure_triggers_relaunch(k8s):
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-0", "Running")
+        api.set_pod_phase(
+            "tj-worker-0", "Failed", reason="Evicted"
+        )
+        # relaunch: a new pod (id 2) replaces worker 0 at rank 0
+        assert _wait_until(lambda: "tj-worker-2" in api.pods)
+        replacement = mgr.get_node(2)
+        assert replacement.rank_index == 0
+        assert replacement.relaunch_count == 1
+    finally:
+        mgr.stop()
+
+
+def test_fatal_error_not_relaunched(k8s):
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-1", "Running")
+        api.set_pod_phase("tj-worker-1", "Failed", exit_code=1)
+        time.sleep(0.5)
+        assert "tj-worker-2" not in api.pods  # no replacement
+    finally:
+        mgr.stop()
+
+
+def test_oom_relaunch_bumps_memory(k8s):
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        original_mem = mgr.get_node(0).config_resource.memory_mb
+        api.set_pod_phase("tj-worker-0", "Running")
+        api.set_pod_phase("tj-worker-0", "Failed", reason="OOMKilled")
+        assert _wait_until(lambda: mgr.get_node(2) is not None)
+        assert mgr.get_node(2).config_resource.memory_mb > original_mem
+    finally:
+        mgr.stop()
+
+
+def test_adjust_worker_count_scales_up_and_down(k8s):
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        for name in list(api.pods):
+            api.set_pod_phase(name, "Running")
+        _wait_until(lambda: all(
+            n.status == NodeStatus.RUNNING
+            for n in mgr.all_nodes().values()
+        ))
+        plan = mgr.adjust_worker_count(4)
+        assert len(plan.launch_nodes) == 2
+        assert _wait_until(lambda: len(api.pods) == 4)
+        for name in list(api.pods):
+            api.set_pod_phase(name, "Running")
+        time.sleep(0.3)
+        plan = mgr.adjust_worker_count(2)
+        assert len(plan.remove_nodes) == 2
+    finally:
+        mgr.stop()
+
+
+def test_elasticjob_scaler_writes_scaleplan_cr(k8s):
+    client, api = k8s
+    scaler = ElasticJobScaler("tj", client)
+    from dlrover_tpu.common.node import new_worker
+
+    plan = ScalePlan(launch_nodes=[new_worker(5, rank=5)])
+    scaler.scale(plan)
+    assert any(
+        key.startswith("scaleplans/tj-scaleplan")
+        for key in api.custom_resources
+    )
+    body = list(api.custom_resources.values())[0]
+    assert body["spec"]["createPods"][0]["id"] == 5
+
+
+def test_auto_scaler_probes_up(k8s):
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        for name in list(api.pods):
+            api.set_pod_phase(name, "Running")
+        _wait_until(lambda: sum(
+            1 for n in mgr.all_nodes().values()
+            if n.status == NodeStatus.RUNNING
+        ) == 2)
+        sm = SpeedMonitor()
+        sm.set_batch_size(32)
+        now = time.time()
+        for i in range(10):
+            sm.collect_global_step(i * 10, now + i)
+        scaler = AllreduceAutoScaler(
+            mgr, sm, optimizer=LocalOptimizer(), interval=3600,
+            min_nodes=1, max_nodes=8, node_unit=1,
+        )
+        scaler.execute_scale_once()
+        # throughput present with empty history -> probe scale-up
+        assert _wait_until(lambda: len(api.pods) == 3)
+    finally:
+        mgr.stop()
