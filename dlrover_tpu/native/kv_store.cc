@@ -1,0 +1,394 @@
+// Host-side dynamic-capacity sparse embedding store ("KvVariable").
+//
+// Reference capability: TFPlus KvVariable custom-op set
+// (tfplus/tfplus/kv_variable/ops/kv_variable_ops.cc:37-536 — Gather/
+// GatherOrInsert/GatherOrZeros, ScatterAdd/Sub/Mul, Import/Export,
+// frequency counts, under/overflow policies) and its sparse training
+// kernels (kernels/training_ops.cc: group Adam/Adagrad/FTRL applying
+// updates only to touched keys).
+//
+// TPU-native shape: the table lives on the host (embedding tables are
+// far larger than HBM); lookups produce a dense [n, dim] batch that
+// jax feeds to the device; gradient scatter and the sparse group
+// optimizers run here, touching only the gathered keys.  Exposed as a
+// C ABI consumed via ctypes (dlrover_tpu/ops/kv_variable.py) — no
+// pybind dependency.
+//
+// Implementation: open-addressing hash table (power-of-two capacity,
+// linear probing) storing row indices into a slab of embedding rows;
+// per-key update counters back frequency-based eviction.
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kEmptyKey = INT64_MIN;
+
+struct Table {
+  int dim = 0;
+  // hash slots -> row index (-1 empty)
+  std::vector<int64_t> keys;
+  std::vector<int64_t> rows;
+  // slab of rows: values, per-row key (for export), frequency
+  std::vector<float> values;
+  std::vector<int64_t> row_keys;
+  std::vector<uint64_t> freq;
+  size_t used = 0;
+  uint64_t seed = 0x9e3779b97f4a7c15ull;
+  std::mutex mu;
+
+  explicit Table(int d, size_t capacity) : dim(d) {
+    size_t cap = 64;
+    while (cap < capacity * 2) cap <<= 1;
+    keys.assign(cap, kEmptyKey);
+    rows.assign(cap, -1);
+  }
+
+  size_t mask() const { return keys.size() - 1; }
+
+  static uint64_t hash_key(int64_t k) {
+    uint64_t x = static_cast<uint64_t>(k);
+    x ^= x >> 33;
+    x *= 0xff51afd7ed558ccdull;
+    x ^= x >> 33;
+    x *= 0xc4ceb9fe1a85ec53ull;
+    x ^= x >> 33;
+    return x;
+  }
+
+  void grow() {
+    std::vector<int64_t> old_keys = std::move(keys);
+    std::vector<int64_t> old_rows = std::move(rows);
+    keys.assign(old_keys.size() * 2, kEmptyKey);
+    rows.assign(old_rows.size() * 2, -1);
+    for (size_t i = 0; i < old_keys.size(); ++i) {
+      if (old_keys[i] == kEmptyKey) continue;
+      size_t slot = hash_key(old_keys[i]) & mask();
+      while (keys[slot] != kEmptyKey) slot = (slot + 1) & mask();
+      keys[slot] = old_keys[i];
+      rows[slot] = old_rows[i];
+    }
+  }
+
+  // find slot for key; returns row index or -1
+  int64_t find(int64_t key) const {
+    size_t slot = hash_key(key) & mask();
+    while (true) {
+      if (keys[slot] == key) return rows[slot];
+      if (keys[slot] == kEmptyKey) return -1;
+      slot = (slot + 1) & mask();
+    }
+  }
+
+  // insert key with given init; returns row index
+  int64_t insert(int64_t key, const float* init_row, bool random_init) {
+    if ((used + 1) * 2 > keys.size()) grow();
+    size_t slot = hash_key(key) & mask();
+    while (true) {
+      if (keys[slot] == key) return rows[slot];
+      if (keys[slot] == kEmptyKey) break;
+      slot = (slot + 1) & mask();
+    }
+    int64_t row = static_cast<int64_t>(row_keys.size());
+    keys[slot] = key;
+    rows[slot] = row;
+    row_keys.push_back(key);
+    freq.push_back(0);
+    size_t off = values.size();
+    values.resize(off + dim);
+    if (init_row != nullptr) {
+      std::memcpy(values.data() + off, init_row, sizeof(float) * dim);
+    } else if (random_init) {
+      // per-key deterministic init: splitmix on (seed ^ key)
+      uint64_t s = seed ^ hash_key(key);
+      float scale = 1.0f / std::sqrt(static_cast<float>(dim));
+      for (int i = 0; i < dim; ++i) {
+        s += 0x9e3779b97f4a7c15ull;
+        uint64_t z = s;
+        z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+        z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+        z = z ^ (z >> 31);
+        // uniform [-scale, scale)
+        float u = static_cast<float>(z >> 11) * (1.0f / 9007199254740992.0f);
+        values[off + i] = (2.0f * u - 1.0f) * scale;
+      }
+    } else {
+      std::memset(values.data() + off, 0, sizeof(float) * dim);
+    }
+    ++used;
+    return row;
+  }
+
+  float* row_ptr(int64_t row) { return values.data() + row * dim; }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int dim, long initial_capacity, unsigned long seed) {
+  auto* t = new Table(dim, static_cast<size_t>(initial_capacity));
+  if (seed) t->seed = seed;
+  return t;
+}
+
+void kv_destroy(void* handle) { delete static_cast<Table*>(handle); }
+
+long kv_size(void* handle) {
+  return static_cast<long>(static_cast<Table*>(handle)->used);
+}
+
+int kv_dim(void* handle) { return static_cast<Table*>(handle)->dim; }
+
+// Gather rows for keys; missing keys are inserted (random or zero
+// init) when insert_missing, else zero-filled in the output.
+// Reference ops: KvVariableGatherOrInsert / GatherOrZeros.
+void kv_gather(void* handle, const int64_t* keys, long n, float* out,
+               int insert_missing, int random_init, int count_freq) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (long i = 0; i < n; ++i) {
+    int64_t row = t->find(keys[i]);
+    if (row < 0 && insert_missing) {
+      row = t->insert(keys[i], nullptr, random_init != 0);
+    }
+    if (row < 0) {
+      std::memset(out + i * t->dim, 0, sizeof(float) * t->dim);
+    } else {
+      if (count_freq) t->freq[row] += 1;
+      std::memcpy(out + i * t->dim, t->row_ptr(row),
+                  sizeof(float) * t->dim);
+    }
+  }
+}
+
+// Explicit insert/assign (reference: KvVariableInsert).
+void kv_insert(void* handle, const int64_t* keys, const float* vals,
+               long n) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (long i = 0; i < n; ++i) {
+    int64_t row = t->find(keys[i]);
+    if (row < 0) {
+      t->insert(keys[i], vals + i * t->dim, false);
+    } else {
+      std::memcpy(t->row_ptr(row), vals + i * t->dim,
+                  sizeof(float) * t->dim);
+    }
+  }
+}
+
+// op: 0=add 1=sub 2=mul (reference: KvVariableScatterAdd/Sub/Mul).
+void kv_scatter(void* handle, const int64_t* keys, const float* vals,
+                long n, int op) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (long i = 0; i < n; ++i) {
+    int64_t row = t->find(keys[i]);
+    if (row < 0) row = t->insert(keys[i], nullptr, false);
+    float* dst = t->row_ptr(row);
+    const float* src = vals + i * t->dim;
+    for (int d = 0; d < t->dim; ++d) {
+      if (op == 0) dst[d] += src[d];
+      else if (op == 1) dst[d] -= src[d];
+      else dst[d] *= src[d];
+    }
+  }
+}
+
+// Export all rows (checkpoint).  keys_out: [size], values_out:
+// [size*dim], freq_out: [size].  Returns number exported.
+long kv_export(void* handle, int64_t* keys_out, float* values_out,
+               uint64_t* freq_out, long max_n) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  long n = std::min<long>(max_n, static_cast<long>(t->row_keys.size()));
+  for (long i = 0; i < n; ++i) {
+    keys_out[i] = t->row_keys[i];
+    freq_out[i] = t->freq[i];
+  }
+  std::memcpy(values_out, t->values.data(), sizeof(float) * n * t->dim);
+  return n;
+}
+
+void kv_import(void* handle, const int64_t* keys, const float* vals,
+               const uint64_t* freqs, long n) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (long i = 0; i < n; ++i) {
+    int64_t row = t->find(keys[i]);
+    if (row < 0) row = t->insert(keys[i], vals + i * t->dim, false);
+    else std::memcpy(t->row_ptr(row), vals + i * t->dim,
+                     sizeof(float) * t->dim);
+    if (freqs) t->freq[row] = freqs[i];
+  }
+}
+
+void kv_frequency(void* handle, const int64_t* keys, long n,
+                  uint64_t* out) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (long i = 0; i < n; ++i) {
+    int64_t row = t->find(keys[i]);
+    out[i] = row < 0 ? 0 : t->freq[row];
+  }
+}
+
+// Evict keys with frequency < min_freq (underflow policy; reference:
+// kv_variable frequency/underflow handling).  Rebuilds the slab.
+long kv_evict_below(void* handle, uint64_t min_freq) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  std::vector<int64_t> keep_keys;
+  std::vector<float> keep_values;
+  std::vector<uint64_t> keep_freq;
+  long evicted = 0;
+  for (size_t i = 0; i < t->row_keys.size(); ++i) {
+    if (t->freq[i] >= min_freq) {
+      keep_keys.push_back(t->row_keys[i]);
+      keep_freq.push_back(t->freq[i]);
+      size_t off = keep_values.size();
+      keep_values.resize(off + t->dim);
+      std::memcpy(keep_values.data() + off, t->row_ptr(i),
+                  sizeof(float) * t->dim);
+    } else {
+      ++evicted;
+    }
+  }
+  t->row_keys = std::move(keep_keys);
+  t->values = std::move(keep_values);
+  t->freq = std::move(keep_freq);
+  t->used = t->row_keys.size();
+  std::fill(t->keys.begin(), t->keys.end(), kEmptyKey);
+  std::fill(t->rows.begin(), t->rows.end(), -1);
+  for (size_t i = 0; i < t->row_keys.size(); ++i) {
+    size_t slot = Table::hash_key(t->row_keys[i]) & t->mask();
+    while (t->keys[slot] != kEmptyKey) slot = (slot + 1) & t->mask();
+    t->keys[slot] = t->row_keys[i];
+    t->rows[slot] = static_cast<int64_t>(i);
+  }
+  return evicted;
+}
+
+// ---------------------------------------------------------------------
+// Sparse group optimizers: state tables share key layout with the
+// main table (reference: training_ops.cc + python training/
+// {group_adam,adagrad,group_ftrl}.py — updates touch only the keys in
+// this batch).
+// ---------------------------------------------------------------------
+
+// Group Adam step over the touched keys.
+void kv_apply_group_adam(void* param_h, void* m_h, void* v_h,
+                         const int64_t* keys, const float* grads, long n,
+                         float lr, float beta1, float beta2, float eps,
+                         float weight_decay, long step) {
+  Table* p = static_cast<Table*>(param_h);
+  Table* m = static_cast<Table*>(m_h);
+  Table* v = static_cast<Table*>(v_h);
+  std::lock_guard<std::mutex> lp(p->mu);
+  std::lock_guard<std::mutex> lm(m->mu);
+  std::lock_guard<std::mutex> lv(v->mu);
+  const int dim = p->dim;
+  const float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  const float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  for (long i = 0; i < n; ++i) {
+    int64_t prow = p->find(keys[i]);
+    if (prow < 0) prow = p->insert(keys[i], nullptr, true);
+    int64_t mrow = m->find(keys[i]);
+    if (mrow < 0) mrow = m->insert(keys[i], nullptr, false);
+    int64_t vrow = v->find(keys[i]);
+    if (vrow < 0) vrow = v->insert(keys[i], nullptr, false);
+    float* w = p->row_ptr(prow);
+    float* mu = m->row_ptr(mrow);
+    float* nu = v->row_ptr(vrow);
+    const float* g = grads + i * dim;
+    p->freq[prow] += 1;
+    for (int d = 0; d < dim; ++d) {
+      float gd = g[d] + weight_decay * w[d];
+      mu[d] = beta1 * mu[d] + (1.0f - beta1) * gd;
+      nu[d] = beta2 * nu[d] + (1.0f - beta2) * gd * gd;
+      float mhat = mu[d] / bc1;
+      float vhat = nu[d] / bc2;
+      w[d] -= lr * mhat / (std::sqrt(vhat) + eps);
+    }
+  }
+}
+
+// Group Adagrad step.
+void kv_apply_group_adagrad(void* param_h, void* acc_h,
+                            const int64_t* keys, const float* grads,
+                            long n, float lr, float init_acc, float eps) {
+  Table* p = static_cast<Table*>(param_h);
+  Table* a = static_cast<Table*>(acc_h);
+  std::lock_guard<std::mutex> lp(p->mu);
+  std::lock_guard<std::mutex> la(a->mu);
+  const int dim = p->dim;
+  for (long i = 0; i < n; ++i) {
+    int64_t prow = p->find(keys[i]);
+    if (prow < 0) prow = p->insert(keys[i], nullptr, true);
+    int64_t arow = a->find(keys[i]);
+    if (arow < 0) {
+      a->insert(keys[i], nullptr, false);
+      arow = a->find(keys[i]);
+      float* acc0 = a->row_ptr(arow);
+      for (int d = 0; d < dim; ++d) acc0[d] = init_acc;
+    }
+    float* w = p->row_ptr(prow);
+    float* acc = a->row_ptr(arow);
+    const float* g = grads + i * dim;
+    p->freq[prow] += 1;
+    for (int d = 0; d < dim; ++d) {
+      acc[d] += g[d] * g[d];
+      w[d] -= lr * g[d] / (std::sqrt(acc[d]) + eps);
+    }
+  }
+}
+
+// Group FTRL step (reference: training/group_ftrl.py semantics).
+void kv_apply_group_ftrl(void* param_h, void* z_h, void* n_h,
+                         const int64_t* keys, const float* grads, long n,
+                         float lr, float l1, float l2, float lr_power) {
+  Table* p = static_cast<Table*>(param_h);
+  Table* zt = static_cast<Table*>(z_h);
+  Table* nt = static_cast<Table*>(n_h);
+  std::lock_guard<std::mutex> lp(p->mu);
+  std::lock_guard<std::mutex> lz(zt->mu);
+  std::lock_guard<std::mutex> ln(nt->mu);
+  const int dim = p->dim;
+  for (long i = 0; i < n; ++i) {
+    int64_t prow = p->find(keys[i]);
+    if (prow < 0) prow = p->insert(keys[i], nullptr, false);
+    int64_t zrow = zt->find(keys[i]);
+    if (zrow < 0) zrow = zt->insert(keys[i], nullptr, false);
+    int64_t nrow = nt->find(keys[i]);
+    if (nrow < 0) nrow = nt->insert(keys[i], nullptr, false);
+    float* w = p->row_ptr(prow);
+    float* z = zt->row_ptr(zrow);
+    float* acc = nt->row_ptr(nrow);
+    const float* g = grads + i * dim;
+    p->freq[prow] += 1;
+    (void)lr_power;  // fixed -0.5 (sqrt) schedule, the common case
+    for (int d = 0; d < dim; ++d) {
+      float n_new = acc[d] + g[d] * g[d];
+      float sigma = (std::sqrt(n_new) - std::sqrt(acc[d])) / lr;
+      z[d] += g[d] - sigma * w[d];
+      acc[d] = n_new;
+      float zd = z[d];
+      if (std::fabs(zd) <= l1) {
+        w[d] = 0.0f;
+      } else {
+        float sign = zd > 0 ? 1.0f : -1.0f;
+        w[d] = -(zd - sign * l1) / (l2 + std::sqrt(n_new) / lr);
+      }
+    }
+  }
+}
+
+}  // extern "C"

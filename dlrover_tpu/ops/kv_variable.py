@@ -1,0 +1,290 @@
+"""KvVariable: dynamic-capacity sparse embedding table (ctypes over
+the C++ store) with a JAX bridge.
+
+Reference API surface: TFPlus ``KvVariable`` ops
+(``tfplus/tfplus/kv_variable/ops/kv_variable_ops.cc`` — gather/
+gather-or-insert/gather-or-zeros, scatter add/sub/mul, import/export,
+frequency) and the sparse group optimizers
+(``tfplus/tfplus/training/{group_adam,adagrad,group_ftrl}.py``).
+
+Design: the table lives in host memory (C++,
+:mod:`dlrover_tpu.native`); training embeds a ``gather`` into the
+jitted program via ``jax.pure_callback`` so the dense [n, dim] lookup
+result flows onto the TPU, while gradients come back to the host and
+the C++ group optimizer updates only the touched keys.
+"""
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.native import build_library
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        path = build_library("kv_store")
+        lib = ctypes.CDLL(path)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.kv_create.restype = ctypes.c_void_p
+        lib.kv_create.argtypes = [
+            ctypes.c_int, ctypes.c_long, ctypes.c_ulong,
+        ]
+        lib.kv_destroy.argtypes = [ctypes.c_void_p]
+        lib.kv_size.restype = ctypes.c_long
+        lib.kv_size.argtypes = [ctypes.c_void_p]
+        lib.kv_dim.restype = ctypes.c_int
+        lib.kv_dim.argtypes = [ctypes.c_void_p]
+        lib.kv_gather.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_long, f32p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.kv_insert.argtypes = [
+            ctypes.c_void_p, i64p, f32p, ctypes.c_long,
+        ]
+        lib.kv_scatter.argtypes = [
+            ctypes.c_void_p, i64p, f32p, ctypes.c_long, ctypes.c_int,
+        ]
+        lib.kv_export.restype = ctypes.c_long
+        lib.kv_export.argtypes = [
+            ctypes.c_void_p, i64p, f32p, u64p, ctypes.c_long,
+        ]
+        lib.kv_import.argtypes = [
+            ctypes.c_void_p, i64p, f32p, u64p, ctypes.c_long,
+        ]
+        lib.kv_frequency.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_long, u64p,
+        ]
+        lib.kv_evict_below.restype = ctypes.c_long
+        lib.kv_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_apply_group_adam.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            i64p, f32p, ctypes.c_long,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_long,
+        ]
+        lib.kv_apply_group_adagrad.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64p, f32p,
+            ctypes.c_long, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float,
+        ]
+        lib.kv_apply_group_ftrl.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            i64p, f32p, ctypes.c_long, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float,
+        ]
+        _lib = lib
+    return _lib
+
+
+def _i64(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u64(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+class KvVariable:
+    """Host-side sparse embedding table."""
+
+    def __init__(self, dim: int, initial_capacity: int = 1024,
+                 seed: int = 0, name: str = "kv"):
+        self._lib = _load()
+        self.dim = dim
+        self.name = name
+        self._handle = ctypes.c_void_p(
+            self._lib.kv_create(dim, initial_capacity, seed)
+        )
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.kv_destroy(self._handle)
+                self._handle = None
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    def __len__(self) -> int:
+        return int(self._lib.kv_size(self._handle))
+
+    def gather(
+        self, keys: np.ndarray, insert_missing: bool = True,
+        random_init: bool = True, count_freq: bool = True,
+    ) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        out = np.empty((keys.size, self.dim), dtype=np.float32)
+        self._lib.kv_gather(
+            self._handle, _i64(keys), keys.size, _f32(out),
+            int(insert_missing), int(random_init), int(count_freq),
+        )
+        return out
+
+    def gather_or_zeros(self, keys: np.ndarray) -> np.ndarray:
+        return self.gather(keys, insert_missing=False,
+                           random_init=False, count_freq=False)
+
+    def insert(self, keys: np.ndarray, values: np.ndarray):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        self._lib.kv_insert(
+            self._handle, _i64(keys), _f32(values), keys.size
+        )
+
+    def scatter_add(self, keys, values):
+        self._scatter(keys, values, 0)
+
+    def scatter_sub(self, keys, values):
+        self._scatter(keys, values, 1)
+
+    def scatter_mul(self, keys, values):
+        self._scatter(keys, values, 2)
+
+    def _scatter(self, keys, values, op: int):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        self._lib.kv_scatter(
+            self._handle, _i64(keys), _f32(values), keys.size, op
+        )
+
+    def frequency(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        out = np.zeros(keys.size, dtype=np.uint64)
+        self._lib.kv_frequency(
+            self._handle, _i64(keys), keys.size, _u64(out)
+        )
+        return out
+
+    def evict_below(self, min_freq: int) -> int:
+        return int(
+            self._lib.kv_evict_below(self._handle, min_freq)
+        )
+
+    def export(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        n = len(self)
+        keys = np.empty(n, dtype=np.int64)
+        values = np.empty((n, self.dim), dtype=np.float32)
+        freq = np.empty(n, dtype=np.uint64)
+        got = self._lib.kv_export(
+            self._handle, _i64(keys), _f32(values), _u64(freq), n
+        )
+        return keys[:got], values[:got], freq[:got]
+
+    def import_(self, keys, values, freq=None):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        freq_arr = (
+            np.ascontiguousarray(freq, dtype=np.uint64)
+            if freq is not None
+            else np.zeros(keys.size, dtype=np.uint64)
+        )
+        self._lib.kv_import(
+            self._handle, _i64(keys), _f32(values), _u64(freq_arr),
+            keys.size,
+        )
+
+    # -- JAX bridge --------------------------------------------------------
+
+    def jax_gather(self, keys):
+        """Embed a host gather inside a jitted program via
+        pure_callback; output is a dense [n, dim] f32 array on device.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        keys_shape = keys.shape
+
+        def host_fn(k):
+            return self.gather(np.asarray(k))
+
+        flat = keys.reshape(-1)
+        out = jax.pure_callback(
+            host_fn,
+            jax.ShapeDtypeStruct((flat.shape[0], self.dim),
+                                 jnp.float32),
+            flat,
+        )
+        return out.reshape(*keys_shape, self.dim)
+
+
+class GroupAdamOptimizer:
+    """Sparse Adam over a KvVariable (reference:
+    ``GroupAdamOptimizer``, tfplus/training/group_adam.py:28) —
+    moment tables share the key space; only touched keys update."""
+
+    def __init__(self, table: KvVariable, learning_rate: float = 1e-3,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8, weight_decay: float = 0.0):
+        self._lib = _load()
+        self.table = table
+        self.m = KvVariable(table.dim, name=f"{table.name}/m")
+        self.v = KvVariable(table.dim, name=f"{table.name}/v")
+        self.lr = learning_rate
+        self.beta1, self.beta2 = beta1, beta2
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step = 0
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray):
+        self.step += 1
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._lib.kv_apply_group_adam(
+            self.table._handle, self.m._handle, self.v._handle,
+            _i64(keys), _f32(grads), keys.size,
+            self.lr, self.beta1, self.beta2, self.eps,
+            self.weight_decay, self.step,
+        )
+
+
+class GroupAdagradOptimizer:
+    """Sparse Adagrad (reference: tfplus/training/adagrad.py)."""
+
+    def __init__(self, table: KvVariable, learning_rate: float = 0.1,
+                 initial_accumulator: float = 0.1, eps: float = 1e-10):
+        self._lib = _load()
+        self.table = table
+        self.acc = KvVariable(table.dim, name=f"{table.name}/acc")
+        self.lr = learning_rate
+        self.init_acc = initial_accumulator
+        self.eps = eps
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._lib.kv_apply_group_adagrad(
+            self.table._handle, self.acc._handle, _i64(keys),
+            _f32(grads), keys.size, self.lr, self.init_acc, self.eps,
+        )
+
+
+class GroupFtrlOptimizer:
+    """Sparse FTRL (reference: tfplus/training/group_ftrl.py)."""
+
+    def __init__(self, table: KvVariable, learning_rate: float = 0.1,
+                 l1: float = 0.0, l2: float = 0.0):
+        self._lib = _load()
+        self.table = table
+        self.z = KvVariable(table.dim, name=f"{table.name}/z")
+        self.n = KvVariable(table.dim, name=f"{table.name}/n")
+        self.lr = learning_rate
+        self.l1, self.l2 = l1, l2
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._lib.kv_apply_group_ftrl(
+            self.table._handle, self.z._handle, self.n._handle,
+            _i64(keys), _f32(grads), keys.size, self.lr, self.l1,
+            self.l2, -0.5,
+        )

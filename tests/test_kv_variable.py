@@ -1,0 +1,162 @@
+"""KvVariable (C++ sparse embedding store) tests: gather-or-insert
+semantics, scatter ops, frequency/eviction, export/import checkpoint
+round-trip, sparse group optimizers, and the JAX pure_callback
+bridge inside jit."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.kv_variable import (
+    GroupAdagradOptimizer,
+    GroupAdamOptimizer,
+    GroupFtrlOptimizer,
+    KvVariable,
+)
+
+
+def test_gather_or_insert_deterministic():
+    kv = KvVariable(dim=8, seed=7)
+    keys = np.array([1, 5, 9], dtype=np.int64)
+    emb1 = kv.gather(keys)
+    emb2 = kv.gather(keys)
+    assert emb1.shape == (3, 8)
+    np.testing.assert_array_equal(emb1, emb2)  # stable after insert
+    assert len(kv) == 3
+    # different keys get different vectors
+    assert not np.allclose(emb1[0], emb1[1])
+
+
+def test_gather_or_zeros_missing():
+    kv = KvVariable(dim=4)
+    out = kv.gather_or_zeros(np.array([42], dtype=np.int64))
+    np.testing.assert_array_equal(out, np.zeros((1, 4), np.float32))
+    assert len(kv) == 0  # not inserted
+
+
+def test_insert_and_scatter_ops():
+    kv = KvVariable(dim=2)
+    keys = np.array([10, 20], dtype=np.int64)
+    kv.insert(keys, np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    kv.scatter_add(keys, np.ones((2, 2), np.float32))
+    out = kv.gather(keys, insert_missing=False, count_freq=False)
+    np.testing.assert_allclose(out, [[2.0, 3.0], [4.0, 5.0]])
+    kv.scatter_mul(keys, np.full((2, 2), 2.0, np.float32))
+    out = kv.gather(keys, insert_missing=False, count_freq=False)
+    np.testing.assert_allclose(out, [[4.0, 6.0], [8.0, 10.0]])
+
+
+def test_frequency_and_eviction():
+    kv = KvVariable(dim=4)
+    hot = np.array([1], dtype=np.int64)
+    cold = np.array([2], dtype=np.int64)
+    for _ in range(5):
+        kv.gather(hot)
+    kv.gather(cold)
+    assert kv.frequency(hot)[0] == 5
+    assert kv.frequency(cold)[0] == 1
+    evicted = kv.evict_below(3)
+    assert evicted == 1
+    assert len(kv) == 1
+    assert kv.frequency(hot)[0] == 5  # survivor intact
+
+
+def test_export_import_roundtrip():
+    kv = KvVariable(dim=4, seed=3)
+    keys = np.arange(100, dtype=np.int64)
+    emb = kv.gather(keys)
+    k, v, f = kv.export()
+    assert k.size == 100 and v.shape == (100, 4)
+
+    kv2 = KvVariable(dim=4)
+    kv2.import_(k, v, f)
+    emb2 = kv2.gather(keys, insert_missing=False, count_freq=False)
+    # same key order -> same rows
+    order = np.argsort(k)
+    np.testing.assert_allclose(
+        emb2, emb, atol=1e-6
+    )
+
+
+def test_table_growth():
+    kv = KvVariable(dim=4, initial_capacity=8)
+    keys = np.arange(10_000, dtype=np.int64)
+    kv.gather(keys)
+    assert len(kv) == 10_000
+    # spot-check stability after many growths
+    sample = kv.gather(np.array([3, 777, 9999], dtype=np.int64))
+    assert np.isfinite(sample).all()
+
+
+def test_group_adam_reduces_loss():
+    """Sparse embedding regression: pull gathered rows toward targets;
+    only touched keys change."""
+    kv = KvVariable(dim=4, seed=1)
+    opt = GroupAdamOptimizer(kv, learning_rate=0.05)
+    keys = np.array([1, 2, 3], dtype=np.int64)
+    target = np.array(
+        [[1, 1, 1, 1], [2, 2, 2, 2], [-1, -1, -1, -1]], np.float32
+    )
+    untouched = kv.gather(np.array([99], dtype=np.int64)).copy()
+    losses = []
+    for _ in range(200):
+        emb = kv.gather(keys, count_freq=False)
+        grads = 2 * (emb - target)
+        losses.append(float(((emb - target) ** 2).sum()))
+        opt.apply_gradients(keys, grads)
+    assert losses[-1] < 0.05 * losses[0]
+    np.testing.assert_array_equal(
+        kv.gather(np.array([99], dtype=np.int64),
+                  insert_missing=False, count_freq=False),
+        untouched,
+    )
+
+
+def test_group_adagrad_and_ftrl_step():
+    for opt_cls, kwargs in (
+        (GroupAdagradOptimizer, {"learning_rate": 0.5}),
+        (GroupFtrlOptimizer, {"learning_rate": 0.5, "l1": 0.0}),
+    ):
+        kv = KvVariable(dim=2, seed=2)
+        opt = opt_cls(kv, **kwargs)
+        keys = np.array([7], dtype=np.int64)
+        target = np.array([[1.0, -1.0]], np.float32)
+        losses = []
+        for _ in range(300):
+            emb = kv.gather(keys, count_freq=False)
+            losses.append(float(((emb - target) ** 2).sum()))
+            opt.apply_gradients(keys, 2 * (emb - target))
+        assert losses[-1] < 0.1 * max(losses[0], 1e-3), opt_cls.__name__
+
+
+def test_ftrl_l1_sparsifies():
+    kv = KvVariable(dim=4)
+    kv.insert(np.array([5], np.int64), np.zeros((1, 4), np.float32))
+    opt = GroupFtrlOptimizer(kv, learning_rate=0.1, l1=10.0)
+    # small gradients: l1 threshold keeps weights at exactly zero
+    for _ in range(5):
+        opt.apply_gradients(
+            np.array([5], np.int64),
+            np.full((1, 4), 0.1, np.float32),
+        )
+    out = kv.gather(np.array([5], np.int64), insert_missing=False,
+                    count_freq=False)
+    np.testing.assert_array_equal(out, np.zeros((1, 4), np.float32))
+
+
+def test_jax_bridge_gather_in_jit():
+    import jax
+    import jax.numpy as jnp
+
+    kv = KvVariable(dim=8, seed=5)
+    ref = kv.gather(np.array([3, 4], dtype=np.int64))
+
+    @jax.jit
+    def model(keys):
+        emb = kv.jax_gather(keys)
+        return emb.sum(axis=-1)
+
+    out = model(jnp.array([[3, 4]], dtype=jnp.int64))
+    assert out.shape == (1, 2)
+    np.testing.assert_allclose(
+        np.asarray(out)[0], ref.sum(-1), rtol=1e-6
+    )
