@@ -1,0 +1,203 @@
+"""High-level Trainer: auto-accelerate + flash checkpoint + elastic
+data + metrics in one loop.
+
+Reference: ``AtorchTrainer`` (``atorch/trainer/atorch_trainer.py:136``)
+— a HuggingFace-Trainer-compatible loop built on ``auto_accelerate``
+with async flash checkpointing and loss-spike detection
+(``atorch/utils/loss_spike_utils.py``).  The TPU loop drives the
+compiled sharded train step; saves are flash (shm now, storage async);
+resume restores params and the trainer/step counters.
+"""
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.accel import Strategy, auto_accelerate
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer, TrainState
+
+
+@dataclass
+class TrainingArguments:
+    """Reference: ``AtorchArguments`` (atorch/trainer/atorch_args.py)."""
+
+    output_dir: str = "/tmp/dlrover_tpu_out"
+    max_steps: int = 100
+    global_batch_size: int = 8
+    micro_batch_size: int = 8
+    learning_rate: float = 1e-3
+    logging_steps: int = 10
+    save_steps: int = 50
+    save_storage_steps: int = 0  # 0 = same as save_steps
+    eval_steps: int = 0          # 0 = no periodic eval
+    strategy: Optional[Strategy] = None
+    dry_run_candidates: bool = False
+    resume_from_checkpoint: bool = True
+    # loss-spike detection (reference: loss_spike_utils)
+    loss_spike_factor: float = 3.0
+    loss_ema_beta: float = 0.98
+    seed: int = 0
+
+
+class Trainer:
+    def __init__(
+        self,
+        model,
+        args: TrainingArguments,
+        train_data: Iterable,
+        loss_fn: Callable,
+        optim_factory: Optional[Callable] = None,
+        eval_data: Optional[Iterable] = None,
+    ):
+        self.model = model
+        self.args = args
+        self.train_data = train_data
+        self.eval_data = eval_data
+        self.loss_fn = loss_fn
+        self.optim_factory = optim_factory or self._default_optim
+        self._accel = None
+        self._checkpointer: Optional[Checkpointer] = None
+        self.loss_spikes: List[Dict[str, float]] = []
+        self._loss_ema: Optional[float] = None
+
+    def _default_optim(self):
+        import optax
+
+        return optax.adamw(self.args.learning_rate)
+
+    # -- build -------------------------------------------------------------
+
+    def _build(self, sample_batch):
+        self._accel = auto_accelerate(
+            self.model,
+            self.optim_factory,
+            self.loss_fn,
+            sample_batch,
+            strategy=self.args.strategy,
+            dry_run_candidates=self.args.dry_run_candidates,
+            grad_accum=max(
+                1,
+                self.args.global_batch_size
+                // self.args.micro_batch_size,
+            )
+            if self.args.global_batch_size
+            > self.args.micro_batch_size
+            else 1,
+        )
+        self._checkpointer = Checkpointer(self.args.output_dir)
+        self._elastic = ElasticTrainer(
+            global_batch_size=self.args.global_batch_size,
+            micro_batch_size=self.args.micro_batch_size,
+            dp_size=1,
+        )
+
+    # -- checkpoint --------------------------------------------------------
+
+    def _try_resume(self) -> int:
+        if not self.args.resume_from_checkpoint:
+            return 0
+        step, restored = self._checkpointer.load_checkpoint()
+        if step is None:
+            return 0
+        params = jax.tree.map(jnp.asarray, restored["params"])
+        optimizer = self.optim_factory()
+        state = TrainState.create(params, optimizer)
+        state = TrainState(
+            params=state.params, opt_state=state.opt_state,
+            step=jnp.asarray(step, jnp.int32),
+        )
+        self._accel.state = jax.device_put(
+            state, jax.tree.map(lambda x: x.sharding, self._accel.state)
+        )
+        logger.info("resumed training from step %s", step)
+        return int(step)
+
+    def _save(self, step: int, to_storage: bool):
+        state = self._accel.state
+        self._checkpointer.save_checkpoint(
+            step,
+            {
+                "params": state.params,
+                "trainer": self._elastic.state_dict(),
+            },
+            storage_type=(
+                StorageType.DISK if to_storage else StorageType.MEMORY
+            ),
+        )
+
+    # -- loss spike --------------------------------------------------------
+
+    def _check_loss_spike(self, step: int, loss: float):
+        if self._loss_ema is None:
+            self._loss_ema = loss
+            return
+        if loss > self.args.loss_spike_factor * self._loss_ema:
+            logger.warning(
+                "loss spike at step %s: %.4f (ema %.4f)",
+                step, loss, self._loss_ema,
+            )
+            self.loss_spikes.append({"step": step, "loss": loss})
+        beta = self.args.loss_ema_beta
+        self._loss_ema = beta * self._loss_ema + (1 - beta) * loss
+
+    # -- loops -------------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        data_iter = iter(self.train_data)
+        first = next(data_iter)
+        self._build(first)
+        start_step = self._try_resume()
+        self._elastic.global_step = start_step
+
+        step = start_step
+        metrics_out: Dict[str, float] = {}
+        batch = first
+        save_storage_steps = (
+            self.args.save_storage_steps or self.args.save_steps
+        )
+        while step < self.args.max_steps:
+            placed = self._accel.place_batch(batch)
+            self._accel.state, metrics = self._accel.train_step(
+                self._accel.state, placed
+            )
+            step += 1
+            loss = float(metrics["loss"])
+            self._elastic.report_step(metrics)
+            self._check_loss_spike(step, loss)
+            if step % self.args.logging_steps == 0:
+                logger.info(
+                    "step %s loss %.4f grad_norm %.3f",
+                    step, loss, float(metrics["grad_norm"]),
+                )
+            if self.args.save_steps and step % self.args.save_steps == 0:
+                self._save(step, step % save_storage_steps == 0)
+            if self.args.eval_steps and step % self.args.eval_steps == 0:
+                metrics_out["eval_loss"] = self.evaluate()
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                data_iter = iter(self.train_data)
+                batch = next(data_iter)
+        # final storage save
+        self._save(step, True)
+        metrics_out.update(
+            {"final_loss": loss, "steps": step}
+        )
+        return metrics_out
+
+    def evaluate(self) -> float:
+        if self.eval_data is None:
+            return float("nan")
+        losses = []
+        params = self._accel.state.params
+        for batch in self.eval_data:
+            placed = self._accel.place_batch(batch)
+            losses.append(float(self.loss_fn(params, placed)))
+        return float(np.mean(losses)) if losses else float("nan")

@@ -1,0 +1,62 @@
+"""Brain tests: the Bayesian optimizer finds a quadratic optimum;
+the metrics-store service estimates resources from history."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.brain import BayesianOptimizer, BrainService, JobMetricsStore
+from dlrover_tpu.brain.bo import Parameter
+from dlrover_tpu.brain.service import JobMetricRecord
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+
+def test_bo_finds_quadratic_max():
+    opt = BayesianOptimizer(
+        [Parameter("x", -2.0, 2.0), Parameter("y", -2.0, 2.0)], seed=1
+    )
+
+    def reward(c):
+        return -((c["x"] - 0.5) ** 2) - (c["y"] + 0.3) ** 2
+
+    for _ in range(25):
+        cand = opt.suggest(1)[0]
+        opt.observe(cand, reward(cand))
+    best_cfg, best_val = opt.best
+    assert best_val > -0.15
+    assert abs(best_cfg["x"] - 0.5) < 0.5
+    assert abs(best_cfg["y"] + 0.3) < 0.5
+
+
+def test_bo_int_parameter_clipped():
+    opt = BayesianOptimizer([Parameter("n", 1, 8, is_int=True)])
+    for c in opt.suggest(5):
+        assert 1 <= c["n"] <= 8
+        assert float(c["n"]).is_integer()
+
+
+def test_brain_initial_plan_from_history(tmp_path):
+    store = JobMetricsStore(str(tmp_path / "metrics.jsonl"))
+    for name, workers, sps, params in (
+        ("job-a", 4, 100.0, 1_000_000),
+        ("job-b", 8, 120.0, 1_000_000),
+        ("job-c", 2, 90.0, 50_000_000),
+    ):
+        store.persist(JobMetricRecord(
+            job_name=name, workers=workers, samples_per_sec=sps,
+            model_params=params, finished=True,
+        ))
+    brain = BrainService(store, job_name="new-job")
+    plan = brain.initial_resource_plan(model_params=1_100_000)
+    # picks the similar-size job with best per-worker throughput
+    assert plan.worker_count in (4, 8)
+    assert "similar job" in plan.comment
+
+
+def test_brain_worker_plan_prefers_best_observed(tmp_path):
+    store = JobMetricsStore(str(tmp_path / "m.jsonl"))
+    brain = BrainService(store, job_name="j1")
+    # 2 workers scale better per-worker than 8
+    for w, sps in ((2, 100.0), (4, 150.0), (8, 160.0)):
+        brain.persist_metrics(workers=w, samples_per_sec=sps)
+    plan = brain.generate_worker_plan(8, SpeedMonitor())
+    assert plan.worker_count == 2
