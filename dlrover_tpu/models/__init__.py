@@ -2,5 +2,6 @@
 strategy engine's dry-runner, and the benchmarks."""
 
 from dlrover_tpu.models.gpt import GPT, GPTConfig
+from dlrover_tpu.models.llama import Llama, LlamaConfig
 
-__all__ = ["GPT", "GPTConfig"]
+__all__ = ["GPT", "GPTConfig", "Llama", "LlamaConfig"]

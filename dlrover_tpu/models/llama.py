@@ -1,0 +1,190 @@
+"""Llama-family decoder (RMSNorm + RoPE + SwiGLU + GQA).
+
+The reference accelerates HF Llama via module swaps
+(``atorch/modules/transformer/layers.py:1353 LlamaAttentionFA``,
+auto_accelerate FSDP strategies); the BASELINE north star trains
+Llama-2-7B.  This is a native flax implementation sharing the GPT
+conventions: bf16 compute / fp32 norms, fused projections, pluggable
+attention (Pallas flash), param names matched by the TP partition
+rules (q_proj/k_proj/v_proj/o_proj, gate/up/down).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.gpt import get_attention_fn
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32      # < num_heads -> grouped-query attn
+    hidden_dim: int = 4096
+    intermediate_dim: int = 11008
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_dim // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        return cls(
+            vocab_size=256, max_seq_len=128, num_layers=2,
+            num_heads=4, num_kv_heads=2, hidden_dim=64,
+            intermediate_dim=128, **kw,
+        )
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, max_seq_len=8192, num_layers=32,
+            num_heads=32, num_kv_heads=8, hidden_dim=4096,
+            intermediate_dim=14336, rope_theta=500000.0, **kw,
+        )
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x32 = x.astype(jnp.float32)
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), jnp.float32
+        )
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding on [b, s, h, d]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+        q = nn.Dense(
+            cfg.num_heads * hd, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="q_proj",
+        )(x).reshape(b, s, cfg.num_heads, hd)
+        k = nn.Dense(
+            cfg.num_kv_heads * hd, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="k_proj",
+        )(x).reshape(b, s, cfg.num_kv_heads, hd)
+        v = nn.Dense(
+            cfg.num_kv_heads * hd, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="v_proj",
+        )(x).reshape(b, s, cfg.num_kv_heads, hd)
+
+        positions = jnp.arange(s)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if cfg.num_kv_heads != cfg.num_heads:
+            group = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
+
+        attn_fn = get_attention_fn(cfg.attention_impl)
+        out = attn_fn(q, k, v, dtype=cfg.dtype)
+        out = out.reshape(b, s, cfg.num_heads * hd)
+        return nn.Dense(
+            cfg.hidden_dim, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o_proj",
+        )(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        gate = nn.Dense(
+            cfg.intermediate_dim, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="gate",
+        )(x)
+        up = nn.Dense(
+            cfg.intermediate_dim, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="up",
+        )(x)
+        return nn.Dense(
+            cfg.hidden_dim, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="down",
+        )(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        h = RMSNorm(cfg.rms_eps, name="ln_attn")(x)
+        x = x + LlamaAttention(cfg, name="attn")(h)
+        h = RMSNorm(cfg.rms_eps, name="ln_mlp")(x)
+        x = x + LlamaMLP(cfg, name="mlp")(h)
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wte",
+        )(tokens)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x)
+        x = RMSNorm(cfg.rms_eps, name="ln_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+    def init_params(self, rng, batch_size: int = 2, seq_len: int = 0):
+        seq_len = seq_len or min(self.config.max_seq_len, 128)
+        tokens = jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
