@@ -1,0 +1,19 @@
+"""RLHF engine (reference: ``atorch/atorch/rl/`` — ``ModelEngine``
+managing actor/critic/ref/reward models each with its own
+acceleration strategy, DeepSpeed-hybrid-engine re-implementation, PPO
+utilities)."""
+
+from dlrover_tpu.rl.model_engine import ModelRole, RLModelEngine
+from dlrover_tpu.rl.ppo import (
+    gae_advantages,
+    ppo_critic_loss,
+    ppo_policy_loss,
+)
+
+__all__ = [
+    "ModelRole",
+    "RLModelEngine",
+    "gae_advantages",
+    "ppo_critic_loss",
+    "ppo_policy_loss",
+]

@@ -1,0 +1,113 @@
+"""RL model engine: per-role models with per-role strategies.
+
+Reference: ``ModelEngine`` (``atorch/rl/model_engine/
+model_engine.py:35``) manages actor/critic/ref/reward models, each
+accelerated with its own ATorch strategy.  The TPU engine builds:
+
+- trainable roles (actor, critic): an accelerated sharded train step
+  via :func:`dlrover_tpu.accel.auto_accelerate`;
+- frozen roles (ref, reward): a jitted apply for inference only.
+
+All four can share one mesh (per-role strategies emit compatible mesh
+configs) — on TPU the roles are time-multiplexed on the same chips
+rather than placed on separate GPU groups.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from dlrover_tpu.accel import Strategy, auto_accelerate
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ModelRole:
+    ACTOR = "actor"
+    CRITIC = "critic"
+    REF = "ref"
+    REWARD = "reward"
+
+    TRAINABLE = (ACTOR, CRITIC)
+    FROZEN = (REF, REWARD)
+
+
+@dataclass
+class RoleSpec:
+    model: Any
+    loss_fn: Optional[Callable] = None       # trainable roles
+    optim_factory: Optional[Callable] = None
+    strategy: Optional[Strategy] = None
+    params: Any = None                       # frozen roles: given params
+
+
+class RLModelEngine:
+    def __init__(self, sample_batch, roles: Dict[str, RoleSpec]):
+        self._sample_batch = sample_batch
+        self._roles = roles
+        self._accel: Dict[str, Any] = {}
+        self._frozen_apply: Dict[str, Callable] = {}
+        self._frozen_params: Dict[str, Any] = {}
+
+    def build(self):
+        for name, spec in self._roles.items():
+            if name in ModelRole.TRAINABLE:
+                if spec.loss_fn is None or spec.optim_factory is None:
+                    raise ValueError(
+                        f"trainable role {name} needs loss_fn and "
+                        "optim_factory"
+                    )
+                self._accel[name] = auto_accelerate(
+                    spec.model,
+                    spec.optim_factory,
+                    spec.loss_fn,
+                    self._sample_batch,
+                    strategy=spec.strategy
+                    or Strategy(opts=[("parallel_mode", {})]),
+                    dry_run_candidates=False,
+                )
+                logger.info(
+                    "built trainable role %s with strategy %s",
+                    name, self._accel[name].strategy.names(),
+                )
+            else:
+                params = (
+                    spec.params
+                    if spec.params is not None
+                    else spec.model.init_params(jax.random.PRNGKey(0))
+                )
+                self._frozen_params[name] = params
+                model = spec.model
+
+                def apply_fn(p, batch, model=model):
+                    return model.apply({"params": p}, batch)
+
+                self._frozen_apply[name] = jax.jit(apply_fn)
+        return self
+
+    # -- accessors ---------------------------------------------------------
+
+    def train_step(self, role: str):
+        return self._accel[role].train_step
+
+    def state(self, role: str):
+        return self._accel[role].state
+
+    def set_state(self, role: str, state):
+        self._accel[role].state = state
+
+    def place_batch(self, role: str, batch):
+        return self._accel[role].place_batch(batch)
+
+    def infer(self, role: str, inputs):
+        """Frozen-role forward (ref logprobs / reward scores)."""
+        return self._frozen_apply[role](
+            self._frozen_params[role], inputs
+        )
+
+    def sync_ref_from_actor(self):
+        """Refresh the frozen reference policy from the actor (the
+        periodic ref update some RLHF recipes use)."""
+        self._frozen_params[ModelRole.REF] = jax.tree.map(
+            lambda x: x, self._accel[ModelRole.ACTOR].state.params
+        )

@@ -1,0 +1,102 @@
+"""PPO math for RLHF (pure functions).
+
+Reference: ATorch's PPO utilities under ``atorch/rl/`` (model-type
+registry + ppo loss helpers).  Standard PPO-clip with GAE; everything
+is jit-compatible and batched over [batch, time].
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gae_advantages(
+    rewards: jax.Array,      # [b, t]
+    values: jax.Array,       # [b, t]
+    dones: jax.Array,        # [b, t] 1.0 where episode ends
+    gamma: float = 0.99,
+    lam: float = 0.95,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation via reverse scan.
+
+    Returns (advantages [b, t], returns [b, t]).
+    """
+    b, t = rewards.shape
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros((b, 1))], axis=1
+    )
+    not_done = 1.0 - dones
+    deltas = rewards + gamma * next_values * not_done - values
+
+    def step(carry, x):
+        delta_t, nd_t = x
+        carry = delta_t + gamma * lam * nd_t * carry
+        return carry, carry
+
+    # scan over time reversed; inputs transposed to [t, b]
+    _, adv_rev = jax.lax.scan(
+        step,
+        jnp.zeros(b),
+        (deltas.T[::-1], not_done.T[::-1]),
+    )
+    advantages = adv_rev[::-1].T
+    returns = advantages + values
+    # normalize advantages (standard PPO practice)
+    advantages = (advantages - advantages.mean()) / (
+        advantages.std() + 1e-8
+    )
+    return advantages, returns
+
+
+def ppo_policy_loss(
+    logprobs: jax.Array,      # [b, t] new log pi(a|s)
+    old_logprobs: jax.Array,  # [b, t]
+    advantages: jax.Array,    # [b, t]
+    clip_ratio: float = 0.2,
+    mask: jax.Array = None,   # [b, t] valid-token mask
+) -> jax.Array:
+    ratio = jnp.exp(logprobs - old_logprobs)
+    clipped = jnp.clip(ratio, 1 - clip_ratio, 1 + clip_ratio)
+    loss = -jnp.minimum(ratio * advantages, clipped * advantages)
+    if mask is not None:
+        return (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss.mean()
+
+
+def ppo_critic_loss(
+    values: jax.Array,       # [b, t]
+    returns: jax.Array,      # [b, t]
+    old_values: jax.Array = None,
+    clip_value: float = 0.2,
+    mask: jax.Array = None,
+) -> jax.Array:
+    if old_values is not None:
+        v_clipped = old_values + jnp.clip(
+            values - old_values, -clip_value, clip_value
+        )
+        loss = jnp.maximum(
+            (values - returns) ** 2, (v_clipped - returns) ** 2
+        )
+    else:
+        loss = (values - returns) ** 2
+    if mask is not None:
+        return 0.5 * (loss * mask).sum() / jnp.maximum(
+            mask.sum(), 1.0
+        )
+    return 0.5 * loss.mean()
+
+
+def kl_penalty(
+    logprobs: jax.Array, ref_logprobs: jax.Array, kl_coef: float
+) -> jax.Array:
+    """Per-token KL penalty against the frozen reference policy."""
+    return kl_coef * (logprobs - ref_logprobs)
+
+
+def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """log pi of the taken tokens: [b, t, v] x [b, t] -> [b, t]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        logp, tokens[..., None], axis=-1
+    )[..., 0]
