@@ -1,0 +1,160 @@
+"""Agent-side fault-diagnosis data collection.
+
+Reference: ``DiagnosisMonitor`` + collectors
+(``dlrover/python/elastic_agent/monitor/diagnosis.py:37``,
+``elastic_agent/datacollector/{cuda_log_collector,log_collector,
+metrics_collector}.py``): periodically collect stack traces of the
+training processes, tail training logs, and sample chip metrics, and
+report everything to the master so it can diagnose hangs and faults.
+The CUDA-stack collector becomes a Python-stack collector
+(``faulthandler``/py-spy-style via SIGUSR-free /proc sampling is not
+portable, so we use faulthandler dumps for our own process tree and
+``/proc/<pid>/`` state for supervised workers).
+"""
+
+import faulthandler
+import io
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class DataCollector:
+    data_type = "generic"
+
+    def collect(self) -> str:
+        raise NotImplementedError
+
+
+class StackCollector(DataCollector):
+    """All-thread Python stacks of this process (the agent) and the
+    run-state of supervised worker pids (reference:
+    cuda_log_collector's py-spy-style dump)."""
+
+    data_type = "stack"
+
+    def __init__(self, worker_pids_fn=None):
+        self._worker_pids_fn = worker_pids_fn or (lambda: [])
+
+    def collect(self) -> str:
+        import sys
+
+        parts = []
+        for tid, frame in sys._current_frames().items():
+            parts.append(f"Thread {tid}:")
+            parts.extend(
+                line.rstrip()
+                for line in traceback.format_stack(frame)
+            )
+        for pid in self._worker_pids_fn():
+            parts.append(self._proc_state(pid))
+        return "\n".join(parts)
+
+    @staticmethod
+    def _proc_state(pid: int) -> str:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                fields = f.read().split()
+            state = fields[2] if len(fields) > 2 else "?"
+            with open(f"/proc/{pid}/wchan") as f:
+                wchan = f.read().strip()
+            return f"worker pid {pid}: state={state} wchan={wchan}"
+        except OSError:
+            return f"worker pid {pid}: gone"
+
+
+class LogCollector(DataCollector):
+    """Tail of the training log file (reference: log_collector.py)."""
+
+    data_type = "log"
+
+    def __init__(self, log_path: str, tail_bytes: int = 16384):
+        self._path = log_path
+        self._tail = tail_bytes
+
+    def collect(self) -> str:
+        try:
+            size = os.path.getsize(self._path)
+            with open(self._path, "rb") as f:
+                f.seek(max(0, size - self._tail))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+
+class ChipMetricsCollector(DataCollector):
+    """Device memory stats from jax when this process owns chips
+    (reference: metrics_collector.py chip metrics)."""
+
+    data_type = "chip_metrics"
+
+    def collect(self) -> str:
+        try:
+            import jax
+
+            lines = []
+            for dev in jax.local_devices():
+                stats = getattr(dev, "memory_stats", lambda: None)()
+                if stats:
+                    lines.append(
+                        f"{dev}: in_use={stats.get('bytes_in_use', 0)} "
+                        f"limit={stats.get('bytes_limit', 0)}"
+                    )
+            return "\n".join(lines)
+        except Exception as e:  # noqa: BLE001
+            return f"chip metrics unavailable: {e}"
+
+
+class DiagnosisMonitor:
+    """Periodic collection + report loop (reference:
+    diagnosis.py:37,106)."""
+
+    def __init__(
+        self,
+        collectors: Optional[List[DataCollector]] = None,
+        interval: float = 60.0,
+        client: Optional[MasterClient] = None,
+    ):
+        self._collectors = collectors if collectors is not None else [
+            StackCollector(),
+            ChipMetricsCollector(),
+        ]
+        self._interval = interval
+        self._client = client or MasterClient.singleton()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register_collector(self, collector: DataCollector):
+        self._collectors.append(collector)
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="diagnosis"
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def report_once(self):
+        for collector in self._collectors:
+            try:
+                content = collector.collect()
+                if content:
+                    self._client.report_diagnosis_data(
+                        collector.data_type, content
+                    )
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "collector %s failed: %s", collector.data_type, e
+                )
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            self.report_once()

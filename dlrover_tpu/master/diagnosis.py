@@ -1,0 +1,84 @@
+"""Master-side diagnosis: aggregate agent reports into a verdict.
+
+Reference: the master's hang/fault decision logic spread across
+``dist_master.py:242-248`` (all_running_node_hanged), the error
+monitor, and the diagnosis data collected from agents
+(``elastic_agent/monitor/diagnosis.py``).  The manager keeps a rolling
+window of per-node diagnosis data and answers: is the job hung, which
+node is the likely culprit, what action should the master take.
+"""
+
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import ErrorMonitorConstants
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import DiagnosisData
+
+
+@dataclass
+class Diagnosis:
+    hung: bool = False
+    culprit_node: int = -1
+    action: str = ErrorMonitorConstants.ACTION_NONE
+    reason: str = ""
+
+
+class DiagnosisManager:
+    def __init__(self, window: int = 20):
+        self._data: Dict[int, Deque[DiagnosisData]] = defaultdict(
+            lambda: deque(maxlen=window)
+        )
+
+    def collect(self, data: DiagnosisData):
+        self._data[data.node_id].append(data)
+
+    def node_data(self, node_id: int) -> List[DiagnosisData]:
+        return list(self._data.get(node_id, []))
+
+    def diagnose(
+        self,
+        speed_monitor,
+        hang_timeout: float = 1800.0,
+    ) -> Diagnosis:
+        """Combine throughput stall + stack evidence into a verdict
+        (reference: all_running_node_hanged + task_hanged checks)."""
+        last = speed_monitor.last_step_time  # property
+        if last and time.time() - last > hang_timeout:
+            culprit = self._find_stuck_node()
+            return Diagnosis(
+                hung=True,
+                culprit_node=culprit,
+                action=ErrorMonitorConstants.ACTION_RELAUNCH,
+                reason=(
+                    f"no step for {time.time() - last:.0f}s; "
+                    + (
+                        f"node {culprit} stacks show blocked collective"
+                        if culprit >= 0
+                        else "no single culprit identified"
+                    )
+                ),
+            )
+        return Diagnosis()
+
+    def _find_stuck_node(self) -> int:
+        """Heuristic: the node whose latest stack shows a blocking
+        syscall/collective wait while peers progress."""
+        suspects: List[Tuple[int, int]] = []
+        for node_id, datas in self._data.items():
+            stacks = [d for d in datas if d.data_type == "stack"]
+            if not stacks:
+                continue
+            content = stacks[-1].content.lower()
+            score = sum(
+                kw in content
+                for kw in ("wchan=futex", "barrier", "allreduce",
+                           "all_gather", "recv", "state=d")
+            )
+            suspects.append((score, node_id))
+        if not suspects:
+            return -1
+        suspects.sort(reverse=True)
+        return suspects[0][1] if suspects[0][0] > 0 else -1

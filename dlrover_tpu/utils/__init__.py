@@ -1,0 +1,8 @@
+"""Utilities: timers, profiling, numeric checking (reference:
+``atorch/utils/`` — timer.py, prof.py, parse_trace_json.py,
+numberic_checker.py)."""
+
+from dlrover_tpu.utils.timer import Timer, Timers
+from dlrover_tpu.utils.numeric_checker import check_numerics
+
+__all__ = ["Timer", "Timers", "check_numerics"]

@@ -1,0 +1,95 @@
+"""μP — maximal update parametrization.
+
+Reference: ``atorch/atorch/mup/{infshape,init,module,optim,shape}.py``
+(torch modules + optimizer wrappers).  The JAX formulation is
+functional: compare a *base* (narrow) param tree with the target tree
+to derive per-leaf width multipliers, then
+
+- rescale matrix-like initializations by ``1/sqrt(mult)``,
+- scale Adam learning rates of matrix-like params by ``1/mult``
+  (SGD would use ``mult``-independent lr for vectors and ``1/mult``
+  handled through init),
+- scale output logits by ``1/mult`` via :func:`output_multiplier`.
+
+This preserves optimal hyperparameters across width (muTransfer).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _fan_in(shape) -> int:
+    if len(shape) < 1:
+        return 1
+    if len(shape) == 1:
+        return 1
+    return int(np.prod(shape[:-1]))
+
+
+def width_multipliers(base_params, params):
+    """Per-leaf width multiplier tree: fan_in / base_fan_in.
+
+    Matrix-like leaves (ndim >= 2) get mult = fan_in ratio; vectors
+    and scalars get 1.0 (they are 'infinite-width invariant').
+    """
+
+    def per_leaf(base, target):
+        if getattr(target, "ndim", 0) < 2:
+            return 1.0
+        return max(
+            _fan_in(target.shape) / max(_fan_in(base.shape), 1), 1e-9
+        )
+
+    return jax.tree.map(per_leaf, base_params, params)
+
+
+def scale_init(params, mults):
+    """Rescale matrix inits by 1/sqrt(mult) (μP init rule)."""
+
+    def per_leaf(p, m):
+        if getattr(p, "ndim", 0) < 2 or m == 1.0:
+            return p
+        return p / jnp.sqrt(jnp.asarray(m, p.dtype))
+
+    return jax.tree.map(per_leaf, params, mults)
+
+
+def mup_adam(
+    learning_rate: float,
+    mults,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Adam with per-leaf μP learning-rate scaling: matrix-like params
+    step with lr/mult (reference: mup/optim.py MuAdam)."""
+    base = (
+        optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps,
+                    weight_decay=weight_decay)
+        if weight_decay
+        else optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+    )
+
+    def scale_updates(updates, state, params=None):
+        del state, params
+        return (
+            jax.tree.map(
+                lambda u, m: u / m if m != 1.0 else u, updates, mults
+            ),
+            optax.EmptyState(),
+        )
+
+    scaler = optax.GradientTransformation(
+        lambda params: optax.EmptyState(), scale_updates
+    )
+    return optax.chain(base, scaler)
+
+
+def output_multiplier(base_width: int, width: int) -> float:
+    """Scale for the readout logits: base_width / width."""
+    return base_width / width

@@ -1,0 +1,50 @@
+"""Numeric health checks on pytrees (reference:
+``atorch/utils/numberic_checker.py`` — guards against NaN/Inf and
+silent dtype drift between two implementations)."""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def check_numerics(tree, name: str = "tree") -> List[str]:
+    """Return a list of problems (empty = healthy)."""
+    import jax
+
+    problems = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        key = name + "/" + "/".join(str(p) for p in path)
+        finite = np.isfinite(arr.astype(np.float32))
+        if not finite.all():
+            bad = int((~finite).sum())
+            problems.append(f"{key}: {bad} non-finite values")
+        elif arr.size and float(np.abs(arr.astype(np.float32)).max()) > 1e8:
+            problems.append(f"{key}: magnitude > 1e8")
+    return problems
+
+
+def compare_pytrees(
+    a, b, rtol: float = 1e-4, atol: float = 1e-5
+) -> List[str]:
+    """Structural + numeric diff of two pytrees (golden checks)."""
+    import jax
+
+    mism = []
+    flat_a, td_a = jax.tree_util.tree_flatten_with_path(a)
+    flat_b, td_b = jax.tree_util.tree_flatten_with_path(b)
+    if td_a != td_b:
+        return ["pytree structures differ"]
+    for (path, la), (_, lb) in zip(flat_a, flat_b):
+        key = "/".join(str(p) for p in path)
+        xa, xb = np.asarray(la, np.float32), np.asarray(lb, np.float32)
+        if xa.shape != xb.shape:
+            mism.append(f"{key}: shape {xa.shape} vs {xb.shape}")
+        elif not np.allclose(xa, xb, rtol=rtol, atol=atol):
+            mism.append(
+                f"{key}: max abs diff {np.abs(xa - xb).max():.3e}"
+            )
+    return mism

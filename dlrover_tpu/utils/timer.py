@@ -1,0 +1,60 @@
+"""Named timers for step phases (reference: atorch/utils/timer.py).
+
+Device-aware: ``stop`` can block on a jax array so timed regions
+include device execution, not just dispatch.
+"""
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self.elapsed_total = 0.0
+        self.count = 0
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self, block_on=None):
+        if block_on is not None:
+            import jax
+
+            jax.block_until_ready(block_on)
+        if self._start is not None:
+            self.elapsed_total += time.perf_counter() - self._start
+            self.count += 1
+            self._start = None
+
+    @property
+    def mean(self) -> float:
+        return self.elapsed_total / self.count if self.count else 0.0
+
+
+class Timers:
+    def __init__(self):
+        self._timers: Dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self._timers:
+            self._timers[name] = Timer(name)
+        return self._timers[name]
+
+    @contextmanager
+    def scope(self, name: str, block_on=None):
+        t = self(name)
+        t.start()
+        try:
+            yield t
+        finally:
+            t.stop(block_on)
+
+    def summary(self) -> Dict[str, float]:
+        return {n: t.mean for n, t in self._timers.items()}
+
+    def log(self, logger):
+        for name, mean in sorted(self.summary().items()):
+            logger.info("timer %-24s mean %.4fs", name, mean)

@@ -1,0 +1,103 @@
+"""Diagnosis + utils tests: collectors produce data, the master
+diagnoses a hang with a culprit, timers, numeric checker, muP."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.diagnosis import (
+    ChipMetricsCollector,
+    LogCollector,
+    StackCollector,
+)
+from dlrover_tpu.common.messages import DiagnosisData
+from dlrover_tpu.master.diagnosis import DiagnosisManager
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.utils import Timer, Timers, check_numerics
+from dlrover_tpu.utils.mup import (
+    mup_adam,
+    scale_init,
+    width_multipliers,
+)
+from dlrover_tpu.utils.numeric_checker import compare_pytrees
+
+
+def test_stack_collector_includes_threads():
+    content = StackCollector().collect()
+    assert "Thread" in content or "File" in content
+
+
+def test_log_collector_tails(tmp_path):
+    path = tmp_path / "train.log"
+    path.write_text("line1\n" * 100 + "THE_END\n")
+    content = LogCollector(str(path), tail_bytes=64).collect()
+    assert "THE_END" in content
+    assert len(content) <= 64
+
+
+def test_diagnosis_manager_finds_culprit():
+    mgr = DiagnosisManager()
+    mgr.collect(DiagnosisData(
+        node_id=0, data_type="stack", content="state=R running fine",
+    ))
+    mgr.collect(DiagnosisData(
+        node_id=1, data_type="stack",
+        content="worker pid 7: state=D wchan=futex_wait barrier",
+    ))
+    sm = SpeedMonitor()
+    sm.collect_global_step(5, time.time() - 4000)
+    verdict = mgr.diagnose(sm, hang_timeout=1800)
+    assert verdict.hung
+    assert verdict.culprit_node == 1
+    assert verdict.action == "relaunch"
+
+
+def test_no_hang_when_stepping():
+    mgr = DiagnosisManager()
+    sm = SpeedMonitor()
+    sm.collect_global_step(5, time.time())
+    assert not mgr.diagnose(sm).hung
+
+
+def test_timers_accumulate():
+    timers = Timers()
+    with timers.scope("phase"):
+        time.sleep(0.01)
+    with timers.scope("phase"):
+        time.sleep(0.01)
+    assert timers("phase").count == 2
+    assert timers.summary()["phase"] >= 0.01
+
+
+def test_numeric_checker_flags_nan():
+    good = {"w": jnp.ones(4)}
+    bad = {"w": jnp.array([1.0, jnp.nan, 2.0, jnp.inf])}
+    assert check_numerics(good) == []
+    problems = check_numerics(bad)
+    assert problems and "non-finite" in problems[0]
+    assert compare_pytrees(good, good) == []
+    assert compare_pytrees(
+        good, {"w": jnp.full(4, 2.0)}
+    )
+
+
+def test_mup_width_multipliers_and_transfer():
+    base = {"w": jnp.zeros((8, 8)), "b": jnp.zeros(8)}
+    wide = {"w": jnp.ones((32, 8)), "b": jnp.zeros(8)}
+    mults = width_multipliers(base, wide)
+    assert mults["w"] == 4.0 and mults["b"] == 1.0
+    scaled = scale_init(wide, mults)
+    np.testing.assert_allclose(
+        np.asarray(scaled["w"]), np.full((32, 8), 0.5)
+    )
+    # matrix lr scaled down by mult, vector lr untouched
+    opt = mup_adam(0.1, mults)
+    state = opt.init(wide)
+    grads = {"w": jnp.ones((32, 8)), "b": jnp.ones(8)}
+    updates, _ = opt.update(grads, state, wide)
+    w_step = float(np.abs(np.asarray(updates["w"])).mean())
+    b_step = float(np.abs(np.asarray(updates["b"])).mean())
+    assert w_step == pytest.approx(b_step / 4.0, rel=1e-3)
