@@ -1,0 +1,116 @@
+"""Pipeline parallelism: collective-permute microbatching.
+
+Reference: ATorch's PiPPy graph-split pipeline
+(``atorch/modules/distributed_modules/compilers/pipe_compiler/
+distributed_pippy_compiler.py``, ``PipelineStage.py``).  Graph
+splitting has no JAX analog (SURVEY.md §7 hard parts); the TPU-native
+formulation is SPMD: stage parameters carry a leading stage dim
+sharded over the ``pipeline`` mesh axis, and one ``lax.scan`` runs the
+GPipe schedule — each step every device applies its stage to the
+activation it holds and ``ppermute``s the result to the next stage.
+The schedule is data-independent (static trip count
+``num_micro + num_stages - 1``), so XLA overlaps the permute with the
+next microbatch's compute.
+
+Differentiable end-to-end (scan + ppermute transpose = reverse
+pipeline for the backward pass).
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(params_list):
+    """[per-stage pytrees] -> one pytree with a leading stage dim."""
+    return jax.tree.map(
+        lambda *leaves: jnp.stack(leaves), *params_list
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh,
+    num_microbatches: int,
+    axis: str = "pipeline",
+):
+    """Run ``stage_fn`` as a pipeline over the mesh's pipeline axis.
+
+    ``stage_fn(stage_params, activation) -> activation`` must preserve
+    the activation shape (classic transformer-block stages).
+    ``stacked_params`` leaves have a leading dim == num_stages (sharded
+    over ``axis``); ``x`` is [batch, ...] with batch divisible by
+    ``num_microbatches``.
+    """
+    num_stages = mesh.shape[axis]
+    if num_stages == 1:
+        return stage_fn(
+            jax.tree.map(lambda p: p[0], stacked_params), x
+        )
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by {num_microbatches} microbatches"
+        )
+    mb = b // num_microbatches
+    micro = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    def local(params_stage, micro_local):
+        # params_stage leaves: [1, ...] (this device's stage)
+        params = jax.tree.map(lambda p: p[0], params_stage)
+        stage = jax.lax.axis_index(axis)
+        total_steps = num_microbatches + num_stages - 1
+        perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        def step(carry, t):
+            recv, out_buf = carry
+            feed_idx = jnp.clip(t, 0, num_microbatches - 1)
+            inp = jnp.where(
+                stage == 0, micro_local[feed_idx], recv
+            )
+            out = stage_fn(params, inp)
+            send = jax.lax.ppermute(out, axis, perm)
+            collect_idx = t - (num_stages - 1)
+            is_last = stage == num_stages - 1
+            valid = jnp.logical_and(
+                is_last,
+                jnp.logical_and(
+                    collect_idx >= 0,
+                    collect_idx < num_microbatches,
+                ),
+            )
+            out_buf = jnp.where(
+                valid,
+                jax.lax.dynamic_update_index_in_dim(
+                    out_buf, out,
+                    jnp.clip(collect_idx, 0, num_microbatches - 1),
+                    axis=0,
+                ),
+                out_buf,
+            )
+            return (send, out_buf), None
+
+        recv0 = jnp.zeros_like(micro_local[0])
+        out_buf0 = jnp.zeros_like(micro_local)
+        (_, out_buf), _ = jax.lax.scan(
+            step, (recv0, out_buf0), jnp.arange(total_steps)
+        )
+        # only the last stage holds results; psum replicates them
+        mask = (stage == num_stages - 1).astype(out_buf.dtype)
+        return jax.lax.psum(out_buf * mask, axis)
+
+    out = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(axis), stacked_params),
+            P(),  # microbatches replicated; stage 0 feeds them
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, micro)
+    return out.reshape((b,) + x.shape[1:])
