@@ -1,0 +1,101 @@
+"""Sync service, elastic PS/mesh-epoch versioning, auto-tuning loop
+(master ParallelConfig -> agent tuner file -> trainer read)."""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.config_tuner import (
+    ParalConfigTuner,
+    read_parallel_config,
+)
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.messages import (
+    ModelInfo,
+    NodeResourceStats,
+    ParallelConfig,
+)
+from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.sync_service import ElasticPsService, SyncService
+
+
+def test_sync_service_barrier():
+    svc = SyncService()
+    world = {0, 1}
+    results = {}
+
+    def worker(nid):
+        results[nid] = svc.barrier("phase1", nid, world, timeout=10)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in world
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {0: True, 1: True}
+
+
+def test_sync_service_dead_node_removed():
+    svc = SyncService()
+    world = {0, 1}
+    svc.join_sync("p", 0, world)
+    svc.remove_node(0)
+    assert not svc.join_sync("p", 1, world)  # 0 gone, not complete
+
+
+def test_elastic_ps_versioning():
+    svc = ElasticPsService()
+    assert svc.version == 0
+    v1 = svc.bump_version()
+    assert v1 == 1
+    assert not svc.report_ready(0, 0)  # stale version rejected
+    assert svc.report_ready(0, 1)
+    assert svc.report_ready(1, 1)
+    assert svc.all_ready({0, 1})
+    svc.bump_version()
+    assert not svc.all_ready({0, 1})  # readiness reset on resize
+
+
+def test_strategy_generator_fills_global_batch():
+    gen = SimpleStrategyGenerator(global_batch_size=512)
+    cfg = gen.generate(
+        {0: NodeResourceStats(cpu_percent=50.0)},
+        ModelInfo(num_params=124_000_000),
+        dp_size=4,
+    )
+    assert cfg.micro_batch_size >= 1
+    assert (
+        cfg.micro_batch_size * 4 * cfg.gradient_accumulation <= 512
+    )
+    assert cfg.version == 1
+    cfg2 = gen.generate({}, ModelInfo(), dp_size=4)
+    assert cfg2.version == 2
+
+
+def test_auto_tuning_loop(tmp_path):
+    master = JobMaster(port=0, node_num=1, job_name="tune-test")
+    master.prepare()
+    client = MasterClient(
+        f"127.0.0.1:{master.port}", node_id=0, node_type="worker"
+    )
+    try:
+        # master tunes the config (report path stores it)
+        client._client.report(
+            ParallelConfig(
+                dataloader_workers=3, micro_batch_size=16,
+                gradient_accumulation=2, version=7,
+            )
+        )
+        path = str(tmp_path / "paral.json")
+        tuner = ParalConfigTuner(path=path, client=client)
+        tuner.poll_once()
+        cfg = read_parallel_config(path)
+        assert cfg["micro_batch_size"] == 16
+        assert cfg["version"] == 7
+    finally:
+        client.close()
+        master.stop()
