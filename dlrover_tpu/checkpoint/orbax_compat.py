@@ -1,0 +1,81 @@
+"""Re-shardable global checkpoints via orbax/tensorstore.
+
+Reference capability: the FSDP/Megatron distributed-checkpoint paths
+(``flash_checkpoint/fsdp_engine.py`` implementing torch-DCP
+StorageWriter/Reader, ``megatron_dist_ckpt.py``) whose value is
+*re-sharding on load* — a checkpoint written at one topology restores
+at another.  On TPU the ecosystem-native answer is orbax: global
+``jax.Array`` pytrees are written with sharding metadata and restored
+with *target* shardings, so world-size changes re-shard transparently
+(the SURVEY §7 hard-part about shm shard topology changes is solved at
+the storage tier).
+
+This composes with flash checkpointing: shm snapshots give the
+seconds-order restart path on the same topology; the orbax tier is the
+re-shard-capable durable path.
+"""
+
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class GlobalCheckpointer:
+    """Orbax-backed save/restore of (possibly sharded) pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state, wait: bool = False):
+        """Async by default (orbax writes in background threads)."""
+        self._mngr.save(
+            step, args=self._ocp.args.StandardSave(state)
+        )
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def restore(
+        self, target_state: Optional[Any] = None,
+        step: Optional[int] = None,
+    ) -> Tuple[Optional[int], Any]:
+        """Restore the latest (or given) step.
+
+        ``target_state`` is a pytree of abstract arrays / concrete
+        arrays whose shardings define the RESTORE placement — pass the
+        new topology's state to re-shard an old checkpoint.
+        """
+        step = step if step is not None else self._mngr.latest_step()
+        if step is None:
+            return None, None
+        if target_state is not None:
+            import jax
+
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None),
+                ),
+                target_state,
+            )
+            restored = self._mngr.restore(
+                step,
+                args=self._ocp.args.StandardRestore(abstract),
+            )
+        else:
+            restored = self._mngr.restore(step)
+        logger.info("orbax restore of step %s complete", step)
+        return step, restored
+
+    def wait(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()

@@ -1,0 +1,54 @@
+"""Peer checkpoint backup (ring exchange) and orbax re-shardable
+global checkpoints (save on one sharding, restore on another)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.checkpoint.backup import BackupManager, exchange_with_peer
+from dlrover_tpu.checkpoint.orbax_compat import GlobalCheckpointer
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def test_exchange_with_peer_roundtrip():
+    mesh = build_mesh(MeshConfig(data=-1))
+    payload = b"shard-bytes-of-rank"
+    peer, n = exchange_with_peer(payload, mesh, max_bytes=64)
+    # single-host virtual mesh: every rank sent the same payload, so
+    # the received one equals it — exercises the collective path
+    assert peer == payload and n == len(payload)
+
+
+def test_backup_manager_holds_peer_state():
+    mesh = build_mesh(MeshConfig(data=-1))
+    mgr = BackupManager(mesh)
+    state = {"w": np.arange(4, dtype=np.float32)}
+    mgr.backup(state, step=7, max_bytes=4096)
+    step, restored = mgr.peer_state()
+    assert step == 7
+    np.testing.assert_array_equal(restored["w"], state["w"])
+
+
+def test_orbax_reshard_roundtrip(tmp_path):
+    mesh = build_mesh(MeshConfig(data=-1, fsdp=2, tensor=2))
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    sharded = jax.device_put(x, NamedSharding(mesh, P("fsdp", "tensor")))
+    state = {"w": sharded, "step": jnp.asarray(3)}
+
+    ckpt = GlobalCheckpointer(str(tmp_path / "orbax"))
+    ckpt.save(3, state, wait=True)
+
+    # restore onto a DIFFERENT sharding (topology change)
+    new_target = {
+        "w": jax.device_put(
+            jnp.zeros((8, 8)), NamedSharding(mesh, P("tensor", None))
+        ),
+        "step": jnp.asarray(0),
+    }
+    step, restored = ckpt.restore(new_target)
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(x))
+    assert restored["w"].sharding.spec == P("tensor", None)
+    ckpt.close()
