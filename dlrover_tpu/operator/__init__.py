@@ -1,0 +1,17 @@
+"""Kubernetes operator: ElasticJob/ScalePlan reconciliation.
+
+Reference: the Go kubebuilder operator (``dlrover/go/operator/`` —
+``ElasticJobReconciler`` creating the master pod per ElasticJob,
+``scaleplan_controller.go``; CRD types in
+``api/v1alpha1/elasticjob_types.go:29-118``).  Rebuilt as a Python
+controller against the same API surface: CRD manifests in
+``dlrover_tpu/operator/crds/`` and a reconciler loop that creates the
+job-master pod, tracks job phase, and applies ScalePlans.
+"""
+
+from dlrover_tpu.operator.reconciler import (
+    ElasticJobReconciler,
+    JobPhase,
+)
+
+__all__ = ["ElasticJobReconciler", "JobPhase"]

@@ -1,0 +1,113 @@
+"""ElasticJob reconciler.
+
+Reference: ``ElasticJobReconciler.Reconcile``
+(``dlrover/go/operator/pkg/controllers/elasticjob_controller.go:85``)
++ master pod factory (``pkg/controllers/master/master.go``): for every
+ElasticJob CR, ensure the job-master pod exists, reflect its state
+into the job's phase/conditions, and clean up on completion.  The
+master then owns worker pods itself (PodScaler) or writes ScalePlans.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+
+class JobPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+def master_pod_name(job_name: str) -> str:
+    return f"elasticjob-{job_name}-master"
+
+
+def build_master_pod(job_name: str, spec: Dict) -> Dict:
+    """Reference: master pod factory, pkg/controllers/master/master.go."""
+    worker_spec = spec.get("replicaSpecs", {}).get("worker", {})
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": master_pod_name(job_name),
+            "labels": {
+                "app": "dlrover-tpu",
+                "job": job_name,
+                "role": "master",
+                "node-id": "-1",
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [
+                {
+                    "name": "master",
+                    "command": [
+                        "python", "-m", "dlrover_tpu.master.main",
+                        "--job_name", job_name,
+                        "--node_num",
+                        str(worker_spec.get("replicas", 1)),
+                        "--platform", "kubernetes",
+                    ],
+                    "env": [
+                        {"name": NodeEnv.JOB_NAME, "value": job_name},
+                    ],
+                }
+            ],
+        },
+    }
+
+
+class ElasticJobReconciler:
+    def __init__(self, client: K8sClient):
+        self._client = client
+
+    def reconcile_once(self, jobs: Dict[str, Dict]) -> Dict[str, str]:
+        """Process {job_name: elasticjob_cr}; returns {name: phase}.
+
+        Idempotent — exactly the reconcile contract of the Go
+        controller (missing master pod -> create; completed master ->
+        propagate phase).
+        """
+        phases: Dict[str, str] = {}
+        existing = {
+            p["metadata"]["name"]: p
+            for p in self._client.list_pods("app=dlrover-tpu")
+        }
+        for name, cr in jobs.items():
+            pod_name = master_pod_name(name)
+            pod = existing.get(pod_name)
+            if pod is None:
+                body = build_master_pod(name, cr.get("spec", {}))
+                self._client.create_pod(body)
+                phases[name] = JobPhase.PENDING
+                logger.info(
+                    "created master pod %s for job %s", pod_name, name
+                )
+                continue
+            phase = pod.get("status", {}).get("phase", "Pending")
+            phases[name] = {
+                "Pending": JobPhase.PENDING,
+                "Running": JobPhase.RUNNING,
+                "Succeeded": JobPhase.SUCCEEDED,
+                "Failed": JobPhase.FAILED,
+            }.get(phase, JobPhase.PENDING)
+            cr.setdefault("status", {})["phase"] = phases[name]
+            cr["status"]["masterPod"] = pod_name
+        return phases
+
+    def run(self, get_jobs, interval: float = 5.0, stop_event=None):
+        """Controller loop: poll CRs and reconcile (list+watch in the
+        real deployment; polling keeps the mock path simple)."""
+        while stop_event is None or not stop_event.is_set():
+            try:
+                self.reconcile_once(get_jobs())
+            except Exception:  # noqa: BLE001
+                logger.exception("reconcile failed")
+            time.sleep(interval)
