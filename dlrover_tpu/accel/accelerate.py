@@ -37,7 +37,11 @@ class BuiltPlan:
         from jax.sharding import NamedSharding
 
         return jax.device_put(
-            batch, NamedSharding(self.mesh, batch_spec())
+            batch,
+            NamedSharding(
+                self.mesh,
+                batch_spec(self.plan.sequence_parallel != "none"),
+            ),
         )
 
 
@@ -63,11 +67,19 @@ def _apply_plan_to_model(plan: AccelPlan, context: ModelContext):
     updates: Dict[str, Any] = {}
     if hasattr(cfg, "remat") and plan.remat != cfg.remat:
         updates["remat"] = plan.remat
+    attention_impl = plan.attention_impl
+    if plan.sequence_parallel == "ring":
+        attention_impl = "ring"
+    elif plan.sequence_parallel == "ulysses":
+        attention_impl = (
+            "ulysses_flash" if plan.attention_impl == "flash"
+            else "ulysses"
+        )
     if (
         hasattr(cfg, "attention_impl")
-        and plan.attention_impl != cfg.attention_impl
+        and attention_impl != cfg.attention_impl
     ):
-        updates["attention_impl"] = plan.attention_impl
+        updates["attention_impl"] = attention_impl
     dtype_map = {
         "bfloat16": jnp.bfloat16, "float32": jnp.float32,
         "float16": jnp.float16,
@@ -100,6 +112,9 @@ def build_from_plan(
     from jax.sharding import NamedSharding
 
     mesh = build_mesh(plan.mesh_config, devices=devices)
+    from dlrover_tpu.parallel.mesh import set_global_mesh
+
+    set_global_mesh(mesh)  # ring/ulysses attention resolve it
     model = _apply_plan_to_model(plan, context)
     rebuilt_ctx = dataclasses.replace(context, model=model)
     params = rebuilt_ctx.init_params()
@@ -160,7 +175,9 @@ def build_from_plan(
         )
 
     shardings = state_shardings(state, mesh, plan)
-    batch_sh = NamedSharding(mesh, batch_spec())
+    batch_sh = NamedSharding(
+        mesh, batch_spec(plan.sequence_parallel != "none")
+    )
     jitted = jax.jit(
         step_fn,
         in_shardings=(shardings, batch_sh),

@@ -56,10 +56,12 @@ class GPTConfig:
 
     @classmethod
     def tiny(cls, **kw) -> "GPTConfig":
-        return cls(
+        defaults = dict(
             vocab_size=256, max_seq_len=128, num_layers=2, num_heads=4,
-            hidden_dim=64, **kw,
+            hidden_dim=64,
         )
+        defaults.update(kw)
+        return cls(**defaults)
 
     @classmethod
     def gpt2_small(cls, **kw) -> "GPTConfig":
@@ -92,10 +94,42 @@ def xla_causal_attention(
 
 
 def get_attention_fn(impl: str) -> AttentionFn:
+    """xla | flash | ring | ulysses | ulysses_flash.
+
+    ring/ulysses run over the global mesh's ``sequence`` axis
+    (registered by auto_accelerate); activations must be
+    sequence-sharded by the batch placement.
+    """
     if impl == "flash":
         from dlrover_tpu.ops.flash_attention import flash_attention
 
         return flash_attention
+    if impl == "ring":
+        from dlrover_tpu.parallel.mesh import get_global_mesh
+        from dlrover_tpu.parallel.sequence import ring_attention
+
+        def ring(q, k, v, dtype=jnp.bfloat16):
+            return ring_attention(
+                q, k, v, get_global_mesh(), causal=True
+            ).astype(dtype)
+
+        return ring
+    if impl in ("ulysses", "ulysses_flash"):
+        from dlrover_tpu.parallel.mesh import get_global_mesh
+        from dlrover_tpu.parallel.sequence import ulysses_attention
+
+        inner = (
+            get_attention_fn("flash")
+            if impl == "ulysses_flash"
+            else xla_causal_attention
+        )
+
+        def ulysses(q, k, v, dtype=jnp.bfloat16):
+            return ulysses_attention(
+                inner, q, k, v, get_global_mesh(), dtype=dtype
+            )
+
+        return ulysses
     return xla_causal_attention
 
 

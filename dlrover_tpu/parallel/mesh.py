@@ -103,6 +103,25 @@ def build_mesh(
     return Mesh(dev_array, AXES)
 
 
+_GLOBAL_MESH = None
+
+
+def set_global_mesh(mesh):
+    """Register the mesh model-internal collectives (ring/ulysses
+    attention) should use; set by accelerate.build_from_plan."""
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh():
+    if _GLOBAL_MESH is None:
+        raise RuntimeError(
+            "no global mesh set; call set_global_mesh (or use "
+            "auto_accelerate, which sets it)"
+        )
+    return _GLOBAL_MESH
+
+
 def batch_axes() -> Tuple[str, ...]:
     """Mesh axes the global batch is split over."""
     return ("data", "fsdp")

@@ -74,7 +74,7 @@ def ulysses_attention(
         out = attn_fn(fwd_a2a(q), fwd_a2a(k), fwd_a2a(v), **attn_kwargs)
         return rev_a2a(out)
 
-    spec = P(None, axis, None, None)
+    spec = P(("data", "fsdp"), axis, None, None)
     return jax.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False,
@@ -173,16 +173,17 @@ def ring_attention(
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
             return (acc, m, l, k_nxt, v_nxt), None
 
-        acc0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
-        m0 = jnp.full((b, s_loc, h), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((b, s_loc, h), jnp.float32)
+        bl = q.shape[0]  # local batch (global / dp shards)
+        acc0 = jnp.zeros((bl, s_loc, h, d), jnp.float32)
+        m0 = jnp.full((bl, s_loc, h), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((bl, s_loc, h), jnp.float32)
         (acc, m, l, _, _), _ = jax.lax.scan(
             step, (acc0, m0, l0, k, v), jnp.arange(sp)
         )
         safe_l = jnp.where(l == 0.0, 1.0, l)
         return (acc / safe_l[..., None]).astype(q.dtype)
 
-    spec = P(None, axis, None, None)
+    spec = P(("data", "fsdp"), axis, None, None)
     return jax.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False,

@@ -68,7 +68,7 @@ def test_ring_noncausal(sp_mesh):
 
 
 def test_ring_gradients_match(sp_mesh):
-    q, k, v = _qkv(b=1, s=32, h=2, d=8, seed=3)
+    q, k, v = _qkv(b=2, s=32, h=2, d=8, seed=3)
 
     def loss_ref(q, k, v):
         return (xla_causal_attention(q, k, v, dtype=jnp.float32) ** 2).sum()
@@ -87,7 +87,7 @@ def test_ring_gradients_match(sp_mesh):
 
 
 def test_ulysses_gradients_match(sp_mesh):
-    q, k, v = _qkv(b=1, s=32, h=4, d=8, seed=4)
+    q, k, v = _qkv(b=2, s=32, h=4, d=8, seed=4)
 
     def loss_ref(q, k, v):
         return (xla_causal_attention(q, k, v, dtype=jnp.float32) ** 2).sum()
@@ -111,7 +111,7 @@ def test_ulysses_gradients_match(sp_mesh):
 
 def test_long_context_ring_runs(sp_mesh):
     """Ring attention on a sequence 4x the per-device block."""
-    q, k, v = _qkv(b=1, s=512, h=2, d=16, seed=5)
+    q, k, v = _qkv(b=2, s=512, h=2, d=16, seed=5)
     qs, ks, vs = (_shard(x, sp_mesh) for x in (q, k, v))
     out = ring_attention(qs, ks, vs, sp_mesh)
     assert out.shape == q.shape

@@ -1,0 +1,73 @@
+"""End-to-end sequence parallelism through auto_accelerate: a GPT
+trains with ring attention / ulysses SP on the sequence axis, matching
+the dense model's loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel import Strategy, auto_accelerate
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+
+
+def _fixture():
+    cfg = GPTConfig.tiny(max_seq_len=64)
+    model = GPT(cfg)
+
+    def loss_fn(p, batch, model=model):
+        logits = model.apply({"params": p}, batch["x"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, cfg.vocab_size, (8, 65), dtype=np.int32)
+    batch = {
+        "x": jnp.asarray(data[:, :-1]),  # seq 64: divisible by sp
+        "y": jnp.asarray(data[:, 1:]),
+    }
+    return model, loss_fn, batch
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_sequence_parallel_training_e2e(mode):
+    model, loss_fn, batch = _fixture()
+    result = auto_accelerate(
+        model, lambda: optax.adam(1e-3), loss_fn, batch,
+        strategy=Strategy(opts=[
+            ("sequence_parallel", {"size": 4, "mode": mode}),
+        ]),
+    )
+    assert result.mesh.shape["sequence"] == 4
+    expected_impl = "ring" if mode == "ring" else "ulysses"
+    assert result.model.config.attention_impl == expected_impl
+    placed = result.place_batch(batch)
+    # seq dim really sharded
+    assert not placed["x"].sharding.is_fully_replicated
+    losses = []
+    state = result.state
+    for _ in range(3):
+        state, metrics = result.train_step(state, placed)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_sp_loss_matches_dense_first_step():
+    model, loss_fn, batch = _fixture()
+    dense = auto_accelerate(
+        model, lambda: optax.sgd(0.0), loss_fn, batch,
+        strategy=Strategy(opts=[("parallel_mode", {})]),
+    )
+    _, m_dense = dense.train_step(dense.state, dense.place_batch(batch))
+
+    sp = auto_accelerate(
+        model, lambda: optax.sgd(0.0), loss_fn, batch,
+        strategy=Strategy(opts=[
+            ("sequence_parallel", {"size": 4, "mode": "ring"}),
+        ]),
+    )
+    _, m_sp = sp.train_step(sp.state, sp.place_batch(batch))
+    np.testing.assert_allclose(
+        float(m_dense["loss"]), float(m_sp["loss"]), rtol=2e-2
+    )
