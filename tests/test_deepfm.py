@@ -1,0 +1,79 @@
+"""DeepFM hybrid sparse/dense training: loss decreases, only touched
+keys update, table checkpoint round-trips."""
+
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.common.storage import PosixDiskStorage
+from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+
+
+def _synthetic_batch(rng, cfg, batch=32, vocab=500):
+    sparse = rng.integers(0, vocab, (batch, cfg.num_sparse_fields))
+    dense = rng.normal(size=(batch, cfg.num_dense_features)).astype(
+        np.float32
+    )
+    # learnable rule: label depends on first sparse field parity
+    labels = (sparse[:, 0] % 2).astype(np.float32)
+    return sparse.astype(np.int64), dense, labels
+
+
+def test_deepfm_training_reduces_loss():
+    cfg = DeepFMConfig(
+        num_sparse_fields=4, num_dense_features=3,
+        embedding_dim=8, hidden_dims=(32,),
+    )
+    model = DeepFM(cfg)
+    dense_params = model.init_dense_params()
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(dense_params)
+    rng = np.random.default_rng(0)
+    sparse, dense, labels = _synthetic_batch(rng, cfg, batch=64)
+
+    losses = []
+    for _ in range(60):
+        loss, dgrads, egrads = model.loss_and_grads(
+            dense_params, sparse, dense, labels
+        )
+        losses.append(float(loss))
+        updates, opt_state = optimizer.update(dgrads, opt_state)
+        dense_params = optax.apply_updates(dense_params, updates)
+        model.apply_sparse_gradients(sparse, egrads)
+    assert losses[-1] < 0.6 * losses[0]
+
+
+def test_deepfm_untouched_keys_stable():
+    cfg = DeepFMConfig(num_sparse_fields=2, num_dense_features=2,
+                       embedding_dim=4, hidden_dims=(8,))
+    model = DeepFM(cfg)
+    probe = np.array([99_999], dtype=np.int64)
+    before = model.table.gather(probe).copy()
+    dense_params = model.init_dense_params()
+    rng = np.random.default_rng(1)
+    sparse, dense, labels = _synthetic_batch(rng, cfg, batch=16,
+                                             vocab=100)
+    loss, dgrads, egrads = model.loss_and_grads(
+        dense_params, sparse, dense, labels
+    )
+    model.apply_sparse_gradients(sparse, egrads)
+    after = model.table.gather(probe, insert_missing=False,
+                               count_freq=False)
+    np.testing.assert_array_equal(before, after)
+
+
+def test_deepfm_table_checkpoint(tmp_path):
+    cfg = DeepFMConfig(num_sparse_fields=2, num_dense_features=2,
+                       embedding_dim=4, hidden_dims=(8,))
+    model = DeepFM(cfg)
+    keys = np.arange(50, dtype=np.int64)
+    emb = model.table.gather(keys)
+    storage = PosixDiskStorage()
+    path = str(tmp_path / "table.pkl")
+    model.save_table(storage, path)
+
+    model2 = DeepFM(cfg)
+    assert model2.load_table(storage, path)
+    emb2 = model2.table.gather(keys, insert_missing=False,
+                               count_freq=False)
+    np.testing.assert_allclose(emb, emb2, atol=1e-6)
