@@ -45,7 +45,25 @@ def exchange_with_peer(
     n = mesh.shape[axis]
     if n == 1:
         return payload, len(payload)
-    size = max_bytes or (1 << (len(payload)).bit_length())
+    if max_bytes is None:
+        if jax.process_count() > 1:
+            # a default derived from the *local* payload length lets
+            # processes disagree on the collective's buffer shape and
+            # deadlock/crash the ppermute — callers must agree out of
+            # band (e.g. via the master KV store)
+            raise ValueError(
+                "exchange_with_peer requires an explicitly agreed "
+                "max_bytes in multi-host runs"
+            )
+        max_bytes = 1 << (len(payload)).bit_length()
+    if len(payload) > max_bytes:
+        # fail fast on every rank's next call instead of dying with an
+        # opaque broadcast error after peers entered the collective
+        raise ValueError(
+            f"payload ({len(payload)} bytes) exceeds the agreed "
+            f"max_bytes ({max_bytes}); raise max_bytes collectively"
+        )
+    size = max_bytes
     # [n, size+8] buffer: 8-byte length header + padded payload
     header = np.frombuffer(
         np.int64(len(payload)).tobytes(), dtype=np.uint8
@@ -68,16 +86,23 @@ def exchange_with_peer(
         shard_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
         check_vma=False,
     )(sharded)
-    received = np.asarray(received)
-    # single-host view: row r holds what rank r received
-    out = []
-    for r in range(n):
-        row = received[r]
-        length = int(np.frombuffer(row[:8].tobytes(), dtype=np.int64)[0])
-        out.append(bytes(row[8 : 8 + length].tobytes()))
-    # in a true multi-host run each process sees its own row; in the
-    # single-host (test/virtual-mesh) case return rank 0's view
-    return out[0], len(out[0])
+    # extract only this process's addressable rows — np.asarray on the
+    # global array would raise multi-host where most rows live on
+    # other hosts' devices
+    local_rows = []
+    for sh in received.addressable_shards:
+        data = np.asarray(sh.data)
+        start = sh.index[0].start or 0
+        for j in range(data.shape[0]):
+            local_rows.append((start + j, data[j]))
+    local_rows.sort(key=lambda t: t[0])
+    # multi-host: the single addressable row is what *this* process
+    # received; single-host virtual mesh: every row is addressable and
+    # the first is rank 0's view (test mode)
+    row = local_rows[0][1]
+    length = int(np.frombuffer(row[:8].tobytes(), dtype=np.int64)[0])
+    peer = bytes(row[8 : 8 + length].tobytes())
+    return peer, len(peer)
 
 
 class BackupManager:

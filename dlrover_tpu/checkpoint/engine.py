@@ -102,9 +102,24 @@ class CheckpointEngine:
             self._notified_agent = True
             return
         if not os.path.exists(_socket_path(FACTORY_QUEUE)):
+            # standalone mode (no tpurun agent): host the saver in this
+            # process so the shm/meta/lock servers exist and persists
+            # still happen asynchronously — they just no longer survive
+            # a crash of *this* process (the agent-process deployment
+            # does; reference behaviour is a warning + no persistence)
             logger.warning(
-                "no agent checkpoint-saver factory found; shm snapshots "
-                "will not be persisted asynchronously"
+                "no agent checkpoint-saver factory found; hosting an "
+                "in-process saver (snapshots will not survive a crash "
+                "of this process)"
+            )
+            AsyncCheckpointSaver._instance = AsyncCheckpointSaver(
+                SaverConfig(
+                    checkpoint_dir=self.checkpoint_dir,
+                    local_shard_num=1,
+                    global_shard_num=self.global_shard_num,
+                    node_rank=env_utils.get_node_rank(),
+                    deletion_keep_latest=self._deletion_keep_latest,
+                )
             )
             self._notified_agent = True
             return
@@ -129,13 +144,19 @@ class CheckpointEngine:
         than stall training (reference: save_state_dict_to_memory,
         engine.py:291)."""
         self._notify_agent_to_create_saver()
-        if self._shard_should_persist():
+        # every rank locks its shard: the agent's breakpoint save reads
+        # all local shards, so an unlocked write can be torn even for
+        # ranks that never persist to storage; without an agent there
+        # is no concurrent reader and no lock server to talk to
+        locked = False
+        if self._agent_lock_available():
             if not self._shm_lock.acquire(blocking=False):
                 logger.info(
                     "step %s: saver busy persisting; skipping shm save",
                     step,
                 )
                 return False
+            locked = True
         try:
             config = CheckpointConfig(
                 step=step,
@@ -153,13 +174,18 @@ class CheckpointEngine:
             )
             return True
         finally:
-            if self._shard_should_persist():
+            if locked:
                 self._shm_lock.release()
 
-    def _shard_should_persist(self) -> bool:
-        """Whether this process's shard participates in storage
-        persistence (rank 0 only for replicated checkpoints)."""
-        return not self.replicated or self._rank == 0
+    def _agent_lock_available(self) -> bool:
+        """Whether an agent-side lock server exists for this shard
+        (absent in standalone/no-agent mode, where save_to_memory has
+        no concurrent reader to guard against)."""
+        from dlrover_tpu.common.multi_process import _socket_path
+
+        return os.path.exists(
+            _socket_path(f"{LOCK_PREFIX}_{self._local_rank}")
+        )
 
     def save_to_storage(self, step: int, state_dict, path: str = "") -> bool:
         """Flash save: shm write now, async persist by the agent

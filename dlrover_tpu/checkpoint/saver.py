@@ -260,6 +260,15 @@ class AsyncCheckpointSaver:
         the lock protocol, ckpt_saver.py:558-574)."""
         lock = self._shm_locks[local_rank]
         acquired = lock.acquire(timeout=60.0)
+        if not acquired:
+            # reading shm unlocked races the trainer's next save; a torn
+            # shard must never reach storage (reference aborts too,
+            # ckpt_saver.py:558-574)
+            logger.error(
+                "rank %s: shm lock not acquired within 60s; skipping "
+                "persist of step %s", local_rank, step,
+            )
+            return False
         try:
             config, raw, meta = handler.read_raw()
             if config is None:
@@ -268,11 +277,21 @@ class AsyncCheckpointSaver:
                     local_rank, step,
                 )
                 return False
+            if config.rank >= self.config.global_shard_num:
+                # shard outside the commit protocol (replicated mode
+                # only persists global rank 0); its shm snapshot exists
+                # purely for fast restart-restore — skipping is success
+                return True
             if config.step != step:
+                # shm was overwritten by a newer save (or holds an older
+                # one): persisting it under this step dir would let
+                # commit_checkpoint advance the tracker to a dir with
+                # mixed-step shards (reference: ckpt_saver.py:561)
                 logger.warning(
-                    "rank %s shm holds step %s, wanted %s; persisting "
-                    "what is there", local_rank, config.step, step,
+                    "rank %s shm holds step %s, wanted %s; aborting "
+                    "shard save", local_rank, config.step, step,
                 )
+                return False
             global_rank = config.rank
             self.storage.write(
                 raw, os.path.join(step_dir, shard_file(global_rank))

@@ -195,25 +195,38 @@ class KvVariable:
 
     # -- JAX bridge --------------------------------------------------------
 
-    def jax_gather(self, keys):
-        """Embed a host gather inside a jitted program via
-        pure_callback; output is a dense [n, dim] f32 array on device.
+    def jax_gather(self, keys, insert_missing: bool = True):
+        """Embed a host gather inside a jitted program; output is a
+        dense [n, dim] f32 array on device.
+
+        The default gather mutates the table (inserts missing rows and
+        bumps frequency counters), so it runs through
+        ``io_callback(ordered=True)`` — XLA is free to cache, dedupe or
+        drop *pure* callbacks, which would lose or double-apply the
+        inserts.  With ``insert_missing=False`` the gather is
+        side-effect-free (``gather_or_zeros``) and uses
+        ``pure_callback`` so it stays compatible with vmap/caching.
         """
         import jax
         import jax.numpy as jnp
+        from jax.experimental import io_callback
 
         keys_shape = keys.shape
-
-        def host_fn(k):
-            return self.gather(np.asarray(k))
-
         flat = keys.reshape(-1)
-        out = jax.pure_callback(
-            host_fn,
-            jax.ShapeDtypeStruct((flat.shape[0], self.dim),
-                                 jnp.float32),
-            flat,
+        out_shape = jax.ShapeDtypeStruct(
+            (flat.shape[0], self.dim), jnp.float32
         )
+
+        if insert_missing:
+            def host_fn(k):
+                return self.gather(np.asarray(k))
+
+            out = io_callback(host_fn, out_shape, flat, ordered=True)
+        else:
+            def host_fn(k):
+                return self.gather_or_zeros(np.asarray(k))
+
+            out = jax.pure_callback(host_fn, out_shape, flat)
         return out.reshape(*keys_shape, self.dim)
 
 

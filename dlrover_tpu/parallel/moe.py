@@ -51,11 +51,17 @@ def top_k_gating(
     density_proxy = gates.mean(axis=0)
     aux_loss = (density * density_proxy).sum() * (e**2) / k
 
+    # per-expert occupancy from earlier choices: a choice-c token's
+    # queue position starts after every token the expert received in
+    # choices 0..c-1, so slots never collide across choices (GShard's
+    # ``locations2 += sum(mask1)``, ref ``moe_layer.py`` topk gating)
+    prev_counts = jnp.zeros((e,), dtype=gates.dtype)
     for choice in range(k):
         ids = expert_ids[:, choice]  # [t]
         onehot = jax.nn.one_hot(ids, e, dtype=gates.dtype)  # [t, e]
         # position of each token in its expert's queue (sequence order)
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [t, e]
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + prev_counts) * onehot
+        prev_counts = prev_counts + onehot.sum(axis=0)
         in_cap = (pos < capacity).astype(gates.dtype) * onehot
         pos_clamped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
         cap_onehot = jax.nn.one_hot(

@@ -52,6 +52,23 @@ def test_top2_combine_weights_normalized():
     assert np.asarray(dispatch.sum(axis=(1, 2))).max() <= 2 + 1e-6
 
 
+def test_top2_slots_never_collide_across_choices():
+    # regression: a choice-0 token and a choice-1 token routed to the
+    # same expert must land in distinct capacity slots — otherwise the
+    # dispatch einsum sums both embeddings into one expert input row
+    t, e, cap = 64, 4, 64
+    logits = jax.random.normal(jax.random.PRNGKey(7), (t, e))
+    dispatch, _, _ = top_k_gating(logits, k=2, capacity=cap)
+    slot_occupancy = np.asarray(dispatch.sum(axis=0))  # [e, c]
+    assert slot_occupancy.max() <= 1 + 1e-6, (
+        f"slot collision: max occupancy {slot_occupancy.max()}"
+    )
+    # with ample capacity every token keeps both its choices
+    np.testing.assert_allclose(
+        np.asarray(dispatch.sum(axis=(1, 2))), np.full(t, 2.0), atol=1e-6
+    )
+
+
 def test_capacity_drops_overflow_tokens():
     t, e = 16, 2
     # route everything to expert 0 by making its logit huge
