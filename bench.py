@@ -1,61 +1,259 @@
-"""Benchmark: flash-checkpoint save stall vs synchronous disk save.
+"""Benchmark suite run on the real chip at end of round.
 
-The reference's headline flash-checkpoint claim is ~10x less
-training-blocking time than a synchronous NVMe save (GPT-2 xl;
-``docs/blogs/flash_checkpoint.md:361-383``; BASELINE.md).  This bench
-measures, on the real chip, the training stall of a flash save (the
-device->host shm copy, everything else async in the agent) against a
-synchronous save-to-disk of the same state, and reports the speedup.
-``vs_baseline`` is our speedup divided by the reference's published
-10x.
+Three measurements, one JSON line:
+
+1. **Flash-checkpoint stall** (headline; reference claim ~10x less
+   training-blocking time than a synchronous save,
+   ``docs/blogs/flash_checkpoint.md:361-383``): training stall of a
+   flash save (on-device snapshot + async shm/persist in a separate
+   agent process — the real deployment shape) vs a synchronous
+   device_get + serialize-to-disk of the same ~1.5 GB GPT-2-small
+   state.  ``vs_baseline`` = our speedup / 10.
+2. **Train-step MFU** (detail): GPT-2-small, bf16, flash attention,
+   seq 1024 — tokens/s and model FLOPs utilization on this chip.
+3. **Flash vs XLA attention** (detail): fwd+bwd wall time ratio.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "x", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "x", "vs_baseline": N,
+     "detail": {...}}
 """
 
 import json
 import os
 import pickle
 import shutil
+import statistics
+import subprocess
 import sys
 import tempfile
 import time
 
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v2": 22.5e12,
+    "TPU v3": 61.5e12,  # per chip half of 123 board? v3 chip=123/2? use die
+    "TPU v4": 137.5e12,  # per-chip (two cores) bf16 ~275/2 per die pair
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 229e12,
+    "TPU v5p": 459e12,
+}
 
-def main() -> int:
-    import jax
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "") or ""
+    # longest prefix first so "TPU v5p" is not shadowed by "TPU v5"
+    for name in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(name):
+            return PEAK_FLOPS[name]
+    if device.platform == "cpu":
+        return 1e11
+    return 197e12  # conservative default: v5e-class
+
+
+def bench_train_step(jax, results: dict):
+    """GPT-2-small train step: tokens/s + MFU, flash vs xla attention."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models.gpt import (
+        GPT,
+        GPTConfig,
+        count_params,
+        cross_entropy_loss,
+    )
+    from dlrover_tpu.trainer.elastic_trainer import TrainState
+
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev)
+    smoke = bool(os.getenv("BENCH_SMOKE"))
+    batch, seq = (2, 256) if smoke else (16, 1024)
+    steps = 2 if smoke else 16
+
+    def run(attention_impl: str):
+        cfg = (
+            GPTConfig.tiny(max_seq_len=seq, attention_impl=attention_impl)
+            if smoke
+            else GPTConfig.gpt2_small(
+                max_seq_len=seq, attention_impl=attention_impl
+            )
+        )
+        model = GPT(cfg)
+        params = model.init_params(jax.random.PRNGKey(0), seq_len=seq)
+        optimizer = optax.adamw(3e-4, weight_decay=0.1)
+        state = TrainState.create(params, optimizer)
+        n_params = count_params(params)
+
+        def loss_fn(p, tokens):
+            logits = model.apply({"params": p}, tokens[:, :-1])
+            return cross_entropy_loss(logits, tokens[:, 1:])
+
+        def one_step(state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+            updates, new_opt = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(
+                    params=new_params, opt_state=new_opt,
+                    step=state.step + 1,
+                ),
+                loss,
+            )
+
+        # K steps inside one jit: the deployment shape (no host sync
+        # between steps); a scalar fetch provides the only honest
+        # synchronization point on this backend (block_until_ready
+        # does not wait through the device tunnel)
+        @jax.jit
+        def multi_step(state, tokens):
+            def body(s, _):
+                s, loss = one_step(s, tokens)
+                return s, loss
+
+            state, losses = jax.lax.scan(
+                body, state, None, length=steps
+            )
+            return state, losses[-1]
+
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32
+            )
+        )
+        state, loss = multi_step(state, tokens)  # compile + warm
+        float(loss)
+        t0 = time.perf_counter()
+        state, loss = multi_step(state, tokens)
+        loss = float(loss)
+        dt = (time.perf_counter() - t0) / steps
+        tokens_per_s = batch * seq / dt
+        # PaLM-appendix accounting: 6N per token for the matmuls plus
+        # the causal-attention term 12 * L * seq * hidden
+        flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * (
+            cfg.hidden_dim
+        )
+        mfu = flops_per_token * tokens_per_s / peak
+        return {
+            "step_time_s": round(dt, 4),
+            "tokens_per_s": round(tokens_per_s, 1),
+            "mfu": round(mfu, 4),
+            "loss": loss,
+        }
+
+    flash = run("flash")
+    xla = run("xla")
+    results["train_step"] = {
+        "model": "tiny(smoke)" if smoke else "gpt2_small",
+        "batch": batch,
+        "seq_len": seq,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "peak_flops": peak,
+        "flash_attention": flash,
+        "xla_attention": xla,
+        "flash_vs_xla_step_speedup": round(
+            xla["step_time_s"] / max(flash["step_time_s"], 1e-9), 3
+        ),
+    }
+    results["mfu"] = max(flash["mfu"], xla["mfu"])
+    results["tokens_per_s"] = max(
+        flash["tokens_per_s"], xla["tokens_per_s"]
+    )
+
+
+def bench_attention_kernel(jax, results: dict):
+    """Microbench: Pallas flash attention vs plain XLA attention,
+    fwd+bwd on training-shaped inputs."""
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models.gpt import xla_causal_attention
+    from dlrover_tpu.ops.flash_attention import flash_attention
+
+    smoke = bool(os.getenv("BENCH_SMOKE"))
+    b, s, h, d = (1, 256, 4, 64) if smoke else (4, 2048, 12, 64)
+    q = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(3), (b, s, h, d), jnp.bfloat16)
+
+    reps = 3 if os.getenv("BENCH_SMOKE") else 20
+
+    def time_impl(fn):
+        # reps chained inside one jit + scalar fetch: the tunnel
+        # backend only synchronizes on host transfers
+        @jax.jit
+        def fwd_bwd_loop(q, k, v):
+            def scalar(q):
+                return fn(q, k, v).astype(jnp.float32).sum()
+
+            def body(_, carry):
+                val, g = jax.value_and_grad(scalar)(carry)
+                # fold the grad back in so iterations depend on each
+                # other and cannot be collapsed
+                return carry + 1e-6 * g.astype(carry.dtype)
+
+            q = jax.lax.fori_loop(0, reps, body, q)
+            return q.astype(jnp.float32).sum()
+
+        float(fwd_bwd_loop(q, k, v))  # compile + warm
+        t0 = time.perf_counter()
+        float(fwd_bwd_loop(q, k, v))
+        return (time.perf_counter() - t0) / reps
+
+    t_flash = time_impl(flash_attention)
+    t_xla = time_impl(xla_causal_attention)
+    results["attention_kernel"] = {
+        "shape": [b, s, h, d],
+        "flash_fwd_bwd_s": round(t_flash, 5),
+        "xla_fwd_bwd_s": round(t_xla, 5),
+        "flash_vs_xla_speedup": round(t_xla / max(t_flash, 1e-9), 3),
+    }
+
+
+AGENT_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+AsyncCheckpointSaver.start_async_saving_ckpt()
+print("agent-ready", flush=True)
+while True:
+    time.sleep(0.5)
+"""
+
+
+def bench_flash_ckpt(jax, results: dict, workdir: str):
+    """Flash-ckpt stall vs sync save; saver in a separate process."""
     import jax.numpy as jnp
     import optax
 
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
-    from dlrover_tpu.checkpoint.saver import (
-        AsyncCheckpointSaver,
-        SaverConfig,
-    )
+    from dlrover_tpu.common.constants import CheckpointConstant
     from dlrover_tpu.models.gpt import GPT, GPTConfig, count_params
     from dlrover_tpu.trainer.elastic_trainer import TrainState
 
-    workdir = tempfile.mkdtemp(prefix="dlrover_bench_")
-    os.environ.setdefault(
-        "DLROVER_SHARED_DIR", os.path.join(workdir, "sockets")
-    )
-
     # GPT-2 small + adam: ~124M params x3 states ~1.5 GB fp32 pytree
-    cfg = GPTConfig.gpt2_small(max_seq_len=512)
+    cfg = (
+        GPTConfig.tiny()
+        if os.getenv("BENCH_SMOKE")
+        else GPTConfig.gpt2_small(max_seq_len=512)
+    )
     model = GPT(cfg)
-    params = model.init_params(jax.random.PRNGKey(0), seq_len=512)
-    optimizer = optax.adam(1e-4)
-    state = TrainState.create(params, optimizer)
+    params = model.init_params(
+        jax.random.PRNGKey(0), seq_len=min(512, cfg.max_seq_len)
+    )
+    state = TrainState.create(params, optax.adam(1e-4))
     jax.block_until_ready(state.params)
-    n_params = count_params(params)
-
     state_dict = {
         "params": state.params,
         "opt_state": state.opt_state,
         "step": 100,
     }
+    # warm the host copies so the sync baseline doesn't pay the
+    # first-transfer cost the flash path has already amortized
+    host_state = jax.device_get(state_dict)
 
-    # -- synchronous disk save (the baseline path flash ckpt replaces)
+    # -- synchronous save: the path flash ckpt replaces
     sync_dir = os.path.join(workdir, "sync")
     os.makedirs(sync_dir, exist_ok=True)
     t0 = time.perf_counter()
@@ -64,52 +262,102 @@ def main() -> int:
         pickle.dump(host_state, f)
     f_sync = time.perf_counter() - t0
 
-    # -- flash save: stall is only the device->shm copy
-    ckpt_dir = os.path.join(workdir, "flash")
-    AsyncCheckpointSaver.reset()
-    saver = AsyncCheckpointSaver(
-        SaverConfig(
-            checkpoint_dir=ckpt_dir, local_shard_num=1,
-            global_shard_num=1, node_rank=0,
-        )
+    # -- separate agent process hosting the async saver
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # the agent never touches the chip
+    agent = subprocess.Popen(
+        [sys.executable, "-c", AGENT_SCRIPT.format(repo=os.getcwd())],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True, cwd=os.getcwd(),
     )
-    AsyncCheckpointSaver._instance = saver
+    line = agent.stdout.readline()
+    assert "agent-ready" in line, f"agent failed to start: {line!r}"
+
+    ckpt_dir = os.path.join(workdir, "flash")
     engine = CheckpointEngine(
         ckpt_dir, replicated=True, local_rank=0, global_rank=0,
         world_size=1,
     )
-    # warm up shm allocation (first save pays the mmap fault-in)
-    engine.save_to_memory(1, state_dict)
-    t0 = time.perf_counter()
-    engine.save_to_storage(2, state_dict)
-    f_flash = time.perf_counter() - t0
+    stalls = []
+    try:
+        # warm up (jit of the on-device copy, shm allocation)
+        assert engine.save_to_storage(1, state_dict)
+        assert engine.wait_async(timeout=900.0)
+        for step in (2, 3, 4):
+            t0 = time.perf_counter()
+            ok = engine.save_to_storage(step, state_dict)
+            stalls.append(time.perf_counter() - t0)
+            assert ok, f"flash save of step {step} was skipped"
+            assert engine.wait_async(timeout=900.0)
+            assert engine._last_async_error is None
 
-    # let the async persist finish before tearing the tempdir down
-    from dlrover_tpu.common.constants import CheckpointConstant
+        f_flash = statistics.median(stalls)
+        # integrity: wait for the agent to persist + commit, then load
+        tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
+        deadline = time.time() + 900
+        committed = -1
+        while time.time() < deadline:
+            if os.path.exists(tracker):
+                with open(tracker) as f:
+                    committed = int(f.read().strip() or -1)
+                if committed >= 4:
+                    break
+            time.sleep(0.5)
+        step, restored = engine.load_from_storage()
+        assert step == committed >= 4, (
+            f"persisted step {step} != committed {committed}"
+        )
+    finally:
+        engine.close()
+        agent.kill()
+        agent.wait()
 
-    tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
-    deadline = time.time() + 300
-    while time.time() < deadline and not os.path.exists(tracker):
-        time.sleep(0.5)
-
-    speedup = f_sync / max(f_flash, 1e-9)
-    result = {
-        "metric": "flash_ckpt_stall_speedup_vs_sync_disk",
-        "value": round(speedup, 2),
-        "unit": "x",
-        # reference claims ~10x vs NVMe sync save
-        "vs_baseline": round(speedup / 10.0, 3),
-        "detail": {
-            "sync_save_s": round(f_sync, 3),
-            "flash_stall_s": round(f_flash, 3),
-            "num_params": n_params,
-            "platform": jax.devices()[0].platform,
-        },
+    results["flash_ckpt"] = {
+        "sync_save_s": round(f_sync, 3),
+        "flash_stall_s": round(f_flash, 4),
+        "stalls_s": [round(s, 4) for s in stalls],
+        "num_params": count_params(params),
+        "committed_step": committed,
+        "saver": "separate-process agent",
     }
-    engine.close()
-    AsyncCheckpointSaver.reset()
+    return f_sync / max(f_flash, 1e-9)
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="dlrover_bench_")
+    os.environ.setdefault(
+        "DLROVER_SHARED_DIR", os.path.join(workdir, "sockets")
+    )
+    import jax
+
+    results = {"platform": jax.devices()[0].platform}
+    try:
+        bench_train_step(jax, results)
+    except Exception as e:  # noqa: BLE001
+        results["train_step_error"] = f"{type(e).__name__}: {e}"
+    try:
+        bench_attention_kernel(jax, results)
+    except Exception as e:  # noqa: BLE001
+        results["attention_kernel_error"] = f"{type(e).__name__}: {e}"
+    speedup = 0.0
+    try:
+        speedup = bench_flash_ckpt(jax, results, workdir)
+    except Exception as e:  # noqa: BLE001
+        results["flash_ckpt_error"] = f"{type(e).__name__}: {e}"
     shutil.rmtree(workdir, ignore_errors=True)
-    print(json.dumps(result))
+
+    print(
+        json.dumps(
+            {
+                "metric": "flash_ckpt_stall_speedup_vs_sync_save",
+                "value": round(speedup, 2),
+                "unit": "x",
+                # reference claims ~10x vs sync NVMe save
+                "vs_baseline": round(speedup / 10.0, 3),
+                "detail": results,
+            }
+        )
+    )
     return 0
 
 

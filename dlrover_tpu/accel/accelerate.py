@@ -116,6 +116,27 @@ def build_from_plan(
 
     set_global_mesh(mesh)  # ring/ulysses attention resolve it
     model = _apply_plan_to_model(plan, context)
+    if plan.mesh_config.pipeline > 1:
+        # route the block stack through the GPipe schedule; the plan's
+        # param placement becomes stage-stacked (pipeline axis on the
+        # blocks' leading dim, embed/head replicated)
+        if not hasattr(model, "to_pipelined"):
+            raise ValueError(
+                f"{type(model).__name__} has no to_pipelined hook; "
+                "pipeline_parallel needs a stage-decomposable model"
+            )
+        from dlrover_tpu.parallel.sharding import pipeline_rules
+
+        model = model.to_pipelined(
+            plan.mesh_config.pipeline, plan.pipeline_microbatches
+        )
+        if plan.param_rules.rules:
+            logger.warning(
+                "pipeline_parallel overrides param rules %s with "
+                "stage-stacked placement", plan.param_rules.rules,
+            )
+        plan.param_rules = pipeline_rules()
+        plan.opt_state_rules = None
     rebuilt_ctx = dataclasses.replace(context, model=model)
     params = rebuilt_ctx.init_params()
     optimizer = context.optimizer()

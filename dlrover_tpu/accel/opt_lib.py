@@ -198,18 +198,23 @@ class ModuleReplaceOpt(Optimization):
 
 
 class PipelineParallelOpt(Optimization):
-    """Pipeline stages over the 'pipeline' axis.  Low priority on TPU
-    (SURVEY.md §7 hard parts): GSPMD usually wins; kept for mesh
-    completeness."""
+    """Pipeline stages over the 'pipeline' axis: build_from_plan
+    routes block stacks through ``parallel.pipeline.pipeline_apply``
+    via the model's ``to_pipelined`` hook (reference:
+    pipeline_parallel_optimization.py:56)."""
 
     name = "pipeline_parallel"
     semiauto = True
 
     def apply(self, plan, config, context=None):
         plan.mesh_config.pipeline = int(config.get("size", 2))
+        plan.pipeline_microbatches = int(
+            config.get("microbatches", 4)
+        )
         plan.notes.append(
             f"pipeline x{plan.mesh_config.pipeline} (collective-"
-            "permute microbatching)"
+            f"permute microbatching, "
+            f"{plan.pipeline_microbatches} microbatches)"
         )
         return plan
 

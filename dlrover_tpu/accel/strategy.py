@@ -29,6 +29,7 @@ class AccelPlan:
     attention_impl: str = "xla"
     sequence_parallel: str = "none"  # none | ulysses | ring
     grad_accum: int = 1
+    pipeline_microbatches: int = 4
     notes: List[str] = field(default_factory=list)
 
     def effective_opt_rules(self) -> PartitionRules:

@@ -56,8 +56,25 @@ class Checkpointer:
             return self._engine.save_to_memory(step, state_dict, path)
         return self._engine.save_to_storage(step, state_dict, path)
 
-    def load_checkpoint(self) -> Tuple[Optional[int], Any]:
+    def load_checkpoint(
+        self, target_state: Any = None, orbax_dir: str = "",
+    ) -> Tuple[Optional[int], Any]:
+        """Without ``target_state``: host-array restore (replicated /
+        same-topology).  With ``target_state`` (a pytree of sharded
+        jax.Arrays): every leaf is re-assembled onto the target's
+        shardings — shm, then storage, then the orbax tier at
+        ``orbax_dir`` (reference: fsdp_engine re-shard on load)."""
+        if target_state is not None:
+            return self._engine.load_sharded(
+                target_state, orbax_dir=orbax_dir
+            )
         return self._engine.load()
+
+    def wait(self, timeout: float = 600.0) -> bool:
+        """Block until in-flight async snapshot writes reach shared
+        memory (call before process exit so the last save is
+        restorable)."""
+        return self._engine.wait_async(timeout=timeout)
 
     def close(self):
         self._engine.close()

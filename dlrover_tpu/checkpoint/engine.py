@@ -11,6 +11,8 @@ restart with agent alive) and falls back to storage.
 """
 
 import os
+import queue
+import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -23,9 +25,13 @@ from dlrover_tpu.checkpoint.saver import (
     SaverConfig,
     read_last_checkpoint,
 )
+import numpy as np
+
+from dlrover_tpu.checkpoint.sharded import SHARD_SEP
 from dlrover_tpu.checkpoint.shm_handler import (
     CheckpointConfig,
     SharedMemoryHandler,
+    flat_from_raw,
     state_dict_from_raw,
 )
 from dlrover_tpu.common import env_utils
@@ -52,9 +58,27 @@ class CheckpointEngine:
         global_rank: Optional[int] = None,
         world_size: Optional[int] = None,
         deletion_keep_latest: int = 0,
+        async_snapshot: bool = True,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.replicated = replicated
+        # Async-snapshot mode exploits jax.Array immutability: the
+        # training stall of a flash save is only a cheap on-device copy
+        # (guarding against buffer donation invalidating the refs); the
+        # device->host fetch, shm write and persist enqueue all happen
+        # on a background writer thread.  The reference must copy
+        # synchronously because torch tensors mutate in place
+        # (ckpt_saver.py:174 _traverse_copy_to_shm); JAX does not.
+        # Trade-off: a crash between ``save_to_storage`` returning and
+        # the background shm write completing loses that snapshot (the
+        # previous one remains) — same exposure as the reference's
+        # async persist window.
+        self._async_snapshot = async_snapshot
+        self._writer_queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._writer_thread: Optional[threading.Thread] = None
+        self._writer_lock = threading.Lock()
+        self._jit_copy = None
+        self._last_async_error: Optional[Exception] = None
         self._local_rank = (
             local_rank if local_rank is not None
             else env_utils.get_local_rank()
@@ -187,9 +211,114 @@ class CheckpointEngine:
             _socket_path(f"{LOCK_PREFIX}_{self._local_rank}")
         )
 
+    # -- async snapshot path -------------------------------------------------
+
+    def _device_snapshot(self, state_dict):
+        """Copy every device-array leaf to a fresh on-device buffer.
+
+        The copy runs at HBM bandwidth (milliseconds) and protects the
+        snapshot from buffer donation in the caller's jitted train
+        step; mutable host arrays are copied too (typically tiny —
+        step counters and the like), immutable scalars pass through.
+        """
+        import jax
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(state_dict)
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, np.ndarray):
+                leaves[i] = leaf.copy()
+        idx = [
+            i for i, leaf in enumerate(leaves)
+            if isinstance(leaf, jax.Array)
+        ]
+        if idx:
+            if self._jit_copy is None:
+                import jax.numpy as jnp
+
+                self._jit_copy = jax.jit(
+                    lambda xs: [jnp.copy(x) for x in xs]
+                )
+            copied = self._jit_copy([leaves[i] for i in idx])
+            for i, c in zip(idx, copied):
+                leaves[i] = c
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _ensure_writer(self):
+        with self._writer_lock:
+            if self._writer_thread is None or (
+                not self._writer_thread.is_alive()
+            ):
+                self._writer_thread = threading.Thread(
+                    target=self._writer_loop, daemon=True,
+                    name="ckpt-snapshot-writer",
+                )
+                self._writer_thread.start()
+
+    def _writer_loop(self):
+        while True:
+            item = self._writer_queue.get()
+            if item is None:
+                return
+            step, snap, path, enqueue = item
+            try:
+                ok = self.save_to_memory(step, snap, path)
+                if ok and enqueue and self._event_queue is not None:
+                    self._event_queue.put(
+                        CheckpointEvent(
+                            event_type=CheckpointEventType.SAVE, step=step
+                        )
+                    )
+            except Exception as e:  # noqa: BLE001
+                self._last_async_error = e
+                logger.exception(
+                    "async snapshot of step %s failed", step
+                )
+            finally:
+                self._writer_queue.task_done()
+
+    def wait_async(self, timeout: float = 600.0) -> bool:
+        """Block until in-flight async snapshots are written to shm
+        (tests / shutdown); returns False on timeout.
+        ``unfinished_tasks`` counts queued and in-progress items."""
+        deadline = time.monotonic() + timeout
+        while self._writer_queue.unfinished_tasks:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.02)
+        return True
+
     def save_to_storage(self, step: int, state_dict, path: str = "") -> bool:
-        """Flash save: shm write now, async persist by the agent
-        (reference: save_to_storage in full_ckpt_engine.py)."""
+        """Flash save: shm write + async persist by the agent
+        (reference: save_to_storage in full_ckpt_engine.py).
+
+        With ``async_snapshot`` (default) the training stall is only
+        the on-device copy; the host fetch + shm write happen on the
+        writer thread, which then enqueues the agent persist."""
+        import jax
+
+        has_device_arrays = any(
+            isinstance(leaf, jax.Array)
+            for leaf in jax.tree_util.tree_leaves(state_dict)
+        )
+        if self._async_snapshot and has_device_arrays:
+            if self._writer_queue.unfinished_tasks:
+                logger.info(
+                    "step %s: previous snapshot still writing; "
+                    "skipping save", step,
+                )
+                return False
+            snap = self._device_snapshot(state_dict)
+            # kick off the device->host transfers without blocking
+            for leaf in jax.tree_util.tree_leaves(snap):
+                if isinstance(leaf, jax.Array):
+                    try:
+                        leaf.copy_to_host_async()
+                    except Exception:  # noqa: BLE001
+                        break
+            self._ensure_writer()
+            self._writer_queue.put((step, snap, path, True))
+            return True
         ok = self.save_to_memory(step, state_dict, path)
         if ok and self._event_queue is not None:
             self._event_queue.put(
@@ -235,5 +364,151 @@ class CheckpointEngine:
         logger.info("restored step %s from storage", step)
         return step, state_dict_from_raw(meta, raw)
 
+    def load_sharded(
+        self, target_state, orbax_dir: str = "",
+    ) -> Tuple[Optional[int], Any]:
+        """Restore a GSPMD-sharded pytree onto ``target_state``'s
+        shardings, re-sharding as needed (reference capability:
+        fsdp_engine.py re-shard on load).
+
+        Tier order: (1) this rank's shm snapshot, (2) all visible
+        rank files of the last committed storage step (covers any
+        topology change on a shared filesystem), (3) the orbax tier at
+        ``orbax_dir``.  Every target shard is assembled from the
+        overlapping saved shard boxes; a tier is skipped when its
+        shards do not cover the target arrays.
+        """
+        config, flat, metas = self._shm_handler.load_flat()
+        if config is not None and flat:
+            state = self._assemble_to_target(target_state, flat, metas)
+            if state is not None:
+                logger.info(
+                    "restored sharded step %s from shared memory",
+                    config.step,
+                )
+                return config.step, state
+        step, shards = read_last_checkpoint(
+            self.checkpoint_dir, self._storage
+        )
+        if step is not None and shards:
+            flat_all: Dict[str, Any] = {}
+            metas_all: Dict[str, Any] = {}
+            for rank, (meta, raw) in sorted(shards.items()):
+                f, m = flat_from_raw(meta, raw)
+                for key, val in f.items():
+                    # shard keys collide across ranks; namespace them
+                    nk = (
+                        f"{key}~r{rank}" if SHARD_SEP in key else key
+                    )
+                    flat_all[nk] = val
+                    if key in m:
+                        metas_all[nk] = m[key]
+            state = self._assemble_to_target(
+                target_state, flat_all, metas_all
+            )
+            if state is not None:
+                logger.info(
+                    "restored sharded step %s from storage "
+                    "(%d rank files)", step, len(shards),
+                )
+                return step, state
+        if orbax_dir:
+            from dlrover_tpu.checkpoint.orbax_compat import (
+                GlobalCheckpointer,
+            )
+
+            ckptr = GlobalCheckpointer(orbax_dir)
+            try:
+                return ckptr.restore(target_state)
+            finally:
+                ckptr.close()
+        return None, {}
+
+    def _assemble_to_target(self, target_state, flat, metas):
+        """Assemble every leaf of ``target_state`` from saved entries;
+        None when coverage is incomplete (caller tries next tier)."""
+        import jax
+
+        from dlrover_tpu.checkpoint.sharded import (
+            assemble_global_array,
+            group_shard_entries,
+            is_sharded_leaf,
+        )
+        from dlrover_tpu.checkpoint.shm_handler import (
+            _flatten_state_dict,
+        )
+
+        grouped, plain = group_shard_entries(flat, metas)
+        target_flat = _flatten_state_dict(target_state)
+        out: Dict[str, Any] = {}
+        for key, target_leaf in target_flat.items():
+            if is_sharded_leaf(target_leaf):
+                entries = grouped.get(key)
+                if entries is None and key in plain:
+                    # saved unsharded (replicated whole array)
+                    entries = [(
+                        tuple((0, d) for d in plain[key].shape),
+                        plain[key],
+                    )]
+                if entries is None:
+                    logger.warning("no saved shards for '%s'", key)
+                    return None
+                arr = assemble_global_array(
+                    tuple(target_leaf.shape),
+                    np.dtype(target_leaf.dtype),
+                    target_leaf.sharding,
+                    entries,
+                )
+                if arr is None:
+                    logger.warning(
+                        "saved shards do not cover '%s'", key
+                    )
+                    return None
+                out[key] = arr
+            elif key in plain:
+                val = plain[key]
+                if isinstance(
+                    target_leaf, jax.Array
+                ) and isinstance(val, np.ndarray):
+                    val = jax.device_put(val, target_leaf.sharding)
+                out[key] = val
+            elif key in grouped:
+                # saved sharded, target unsharded: assemble fully
+                from dlrover_tpu.checkpoint.sharded import (
+                    assemble_shard,
+                )
+
+                m = None
+                for mk, mv in metas.items():
+                    if mk.split(SHARD_SEP, 1)[0] == key:
+                        m = mv
+                        break
+                full = assemble_shard(
+                    tuple((0, d) for d in m.global_shape),
+                    np.dtype(m.dtype),
+                    grouped[key],
+                )
+                if full is None:
+                    return None
+                out[key] = full
+            else:
+                logger.warning("missing leaf '%s' in checkpoint", key)
+                return None
+        # rebuild with the target's tree structure
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+            target_state
+        )
+        from dlrover_tpu.checkpoint.shm_handler import _path_str
+
+        ordered = []
+        for path, _ in leaves_with_path:
+            key = "/".join(_path_str(p) for p in path)
+            ordered.append(out[key])
+        return jax.tree_util.tree_unflatten(treedef, ordered)
+
     def close(self):
+        self.wait_async(timeout=60.0)
+        if self._writer_thread is not None and self._writer_thread.is_alive():
+            self._writer_queue.put(None)
+            self._writer_thread.join(timeout=5.0)
         self._shm_handler.close()

@@ -62,7 +62,7 @@ class GlobalCheckpointer:
                 lambda x: jax.ShapeDtypeStruct(
                     x.shape, x.dtype,
                     sharding=getattr(x, "sharding", None),
-                ),
+                ) if hasattr(x, "shape") else x,
                 target_state,
             )
             restored = self._mngr.restore(

@@ -14,6 +14,7 @@ of the leaf yields.  Non-array leaves (step counters, strings, opt
 hyperparams) are pickled into a trailing blob.
 """
 
+import os
 import pickle
 import threading
 from dataclasses import dataclass, field
@@ -37,12 +38,17 @@ from dlrover_tpu.common.multi_process import (
 @dataclass
 class TensorMeta:
     """Placement of one array leaf inside the flat buffer
-    (reference: ckpt_saver.py:65)."""
+    (reference: ckpt_saver.py:65).  For a shard of a global sharded
+    ``jax.Array`` (key suffixed ``@shardN``), ``global_shape`` and
+    ``index`` carry the reassembly metadata (reference shard-aware
+    analog: fsdp_engine.py:568)."""
 
     shape: Tuple[int, ...] = ()
     dtype: str = "float32"
     offset: int = 0
     nbytes: int = 0
+    global_shape: Optional[Tuple[int, ...]] = None
+    index: Optional[Tuple[Tuple[int, int], ...]] = None
 
 
 @dataclass
@@ -101,6 +107,21 @@ def _unflatten_to_nested(flat: Dict[str, Any]) -> Dict[str, Any]:
     return root
 
 
+def default_job_suffix() -> str:
+    """Namespace shm segments per job so two jobs (or a test run next
+    to a live job) on one host never collide: DLROVER_JOB_NAME if set,
+    else a hash of the job's IPC socket dir (which agent and trainers
+    already share)."""
+    import hashlib
+
+    from dlrover_tpu.common.multi_process import socket_dir
+
+    name = os.getenv("DLROVER_JOB_NAME")
+    if name:
+        return name
+    return hashlib.md5(socket_dir().encode()).hexdigest()[:8]
+
+
 class SharedMemoryHandler:
     """Owns one shm segment + meta SharedDict for one local rank."""
 
@@ -110,6 +131,7 @@ class SharedMemoryHandler:
     def __init__(self, local_rank: int, host: bool = False,
                  job_name: str = ""):
         self._rank = local_rank
+        job_name = job_name or default_job_suffix()
         suffix = f"{job_name}_{local_rank}" if job_name else str(local_rank)
         self._shm_name = f"{self.SHM_PREFIX}_{suffix}"
         self._meta = SharedDict(
@@ -130,13 +152,29 @@ class SharedMemoryHandler:
         copies are minimized (reference hot path:
         _traverse_copy_to_shm, ckpt_saver.py:174).
         """
+        from dlrover_tpu.checkpoint.sharded import (
+            SHARD_SEP,
+            is_sharded_leaf,
+            local_shards,
+        )
+
         flat = _flatten_state_dict(state_dict)
         arrays: Dict[str, np.ndarray] = {}
         scalars: Dict[str, Any] = {}
+        shard_info: Dict[str, Tuple[Tuple[int, ...], Tuple]] = {}
         device_keys = []
         for key, leaf in flat.items():
             if isinstance(leaf, (np.ndarray, np.generic)):
                 arrays[key] = np.ascontiguousarray(leaf)
+            elif is_sharded_leaf(leaf):
+                # global sharded array: only this process's addressable
+                # shards go to shm, with reassembly metadata
+                gshape = tuple(leaf.shape)
+                for i, (ranges, data) in enumerate(local_shards(leaf)):
+                    skey = f"{key}{SHARD_SEP}{i}"
+                    arrays[skey] = data
+                    device_keys.append(skey)
+                    shard_info[skey] = (gshape, ranges)
             elif type(leaf).__module__.startswith(("jaxlib", "jax")):
                 arrays[key] = leaf  # fetched in one batched device_get
                 device_keys.append(key)
@@ -153,11 +191,14 @@ class SharedMemoryHandler:
         metas: Dict[str, TensorMeta] = {}
         offset = 0
         for key, arr in arrays.items():
+            gshape, ranges = shard_info.get(key, (None, None))
             metas[key] = TensorMeta(
                 shape=tuple(arr.shape),
                 dtype=str(arr.dtype),
                 offset=offset,
                 nbytes=arr.nbytes,
+                global_shape=gshape,
+                index=ranges,
             )
             offset += arr.nbytes
         total = offset + len(scalar_blob)
@@ -220,19 +261,22 @@ class SharedMemoryHandler:
                 return None
         return self._shm
 
-    def load_state_dict(self) -> Tuple[Optional[CheckpointConfig], Any]:
-        """Zero-copy-read the shm snapshot back into a nested dict of
-        numpy arrays (caller device_puts with its shardings)."""
+    def load_flat(
+        self,
+    ) -> Tuple[Optional[CheckpointConfig], Dict[str, Any], Dict[str, Any]]:
+        """Read the shm snapshot as (config, flat {key: array or
+        scalar}, {key: TensorMeta}) — shard entries keep their
+        ``@shardN`` keys for target-sharded reassembly."""
         meta = self._meta.get(default_if_absent=True)
         if not meta:
-            return None, {}
+            return None, {}, {}
         config: CheckpointConfig = meta["config"]
         if config.writing:
             logger.warning("shm snapshot is mid-write; refusing to load")
-            return None, {}
+            return None, {}, {}
         shm = self._attach()
         if shm is None:
-            return None, {}
+            return None, {}, {}
         flat: Dict[str, Any] = {}
         for key, m in meta["tensors"].items():
             arr = np.frombuffer(
@@ -248,6 +292,17 @@ class SharedMemoryHandler:
             ]
         )
         flat.update(pickle.loads(blob))
+        return config, flat, meta["tensors"]
+
+    def load_state_dict(self) -> Tuple[Optional[CheckpointConfig], Any]:
+        """Zero-copy-read the shm snapshot back into a nested dict of
+        numpy arrays (caller device_puts with its shardings).  Shard
+        entries of global arrays are assembled to full host arrays
+        when this process's shards cover them (always single-host)."""
+        config, flat, metas = self.load_flat()
+        if config is None:
+            return None, {}
+        flat = _assemble_flat(flat, metas)
         return config, _unflatten_to_nested(flat)
 
     def read_raw(self) -> Tuple[Optional[CheckpointConfig], bytes, Dict]:
@@ -275,8 +330,9 @@ class SharedMemoryHandler:
             self._shm = None
 
 
-def state_dict_from_raw(meta: Dict, raw: bytes):
-    """Rebuild the nested dict from raw shm bytes (storage load path)."""
+def flat_from_raw(meta: Dict, raw: bytes) -> Tuple[Dict, Dict]:
+    """(flat {key: array/scalar}, {key: TensorMeta}) from raw shm
+    bytes (storage load path), shard keys preserved."""
     flat: Dict[str, Any] = {}
     for key, m in meta["tensors"].items():
         arr = np.frombuffer(
@@ -289,4 +345,41 @@ def state_dict_from_raw(meta: Dict, raw: bytes):
         meta["scalar_offset"]:meta["scalar_offset"] + meta["scalar_nbytes"]
     ]
     flat.update(pickle.loads(blob))
+    return flat, meta["tensors"]
+
+
+def _assemble_flat(flat: Dict[str, Any], metas: Dict[str, Any]):
+    """Assemble ``@shardN`` entries into full host arrays (raises if
+    the visible shards do not cover a leaf — topology changed across
+    hosts; use the target-sharded restore or the orbax tier)."""
+    from dlrover_tpu.checkpoint.sharded import (
+        SHARD_SEP,
+        assemble_shard,
+        group_shard_entries,
+    )
+
+    grouped, plain = group_shard_entries(flat, metas)
+    for base, entries in grouped.items():
+        some_key = f"{base}{SHARD_SEP}0"
+        m = metas.get(some_key)
+        gshape = tuple(m.global_shape)
+        full = assemble_shard(
+            tuple((0, d) for d in gshape),
+            np.dtype(m.dtype),
+            entries,
+        )
+        if full is None:
+            raise ValueError(
+                f"shards of '{base}' do not cover its global shape "
+                f"{gshape}: restore with a target state "
+                f"(load_sharded) or from the orbax tier"
+            )
+        plain[base] = full
+    return plain
+
+
+def state_dict_from_raw(meta: Dict, raw: bytes):
+    """Rebuild the nested dict from raw shm bytes (storage load path)."""
+    flat, metas = flat_from_raw(meta, raw)
+    flat = _assemble_flat(flat, metas)
     return _unflatten_to_nested(flat)

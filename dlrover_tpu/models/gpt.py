@@ -256,3 +256,145 @@ def count_params(params) -> int:
     return sum(
         int(x.size) for x in jax.tree_util.tree_leaves(params)
     )
+
+
+# -- pipeline parallelism ----------------------------------------------------
+# Reference: ATorch's pipeline compiler splits the module graph into
+# stages (distributed_pippy_compiler.py:541).  The JAX formulation is a
+# params-layout transform: block params are stacked [stages, layers/stage,
+# ...] and sharded over the ``pipeline`` mesh axis; the forward runs the
+# embed/head replicated and the block stack through
+# ``parallel.pipeline.pipeline_apply`` (GPipe over ppermute).
+
+
+def partition_pipeline_params(params, num_stages: int, num_layers: int):
+    """{block_i: ...} -> {"embed": ..., "blocks": [S, L/S, ...], "head"}.
+
+    The inverse layout of the standard GPT params; optimizer state
+    built on this tree inherits the stage-stacked structure.
+    """
+    if num_layers % num_stages:
+        raise ValueError(
+            f"{num_layers} layers not divisible by {num_stages} stages"
+        )
+    blocks = [params[f"block_{i}"] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    staged = jax.tree.map(
+        lambda x: x.reshape(
+            (num_stages, num_layers // num_stages) + x.shape[1:]
+        ),
+        stacked,
+    )
+    embed = {"wte": params["wte"], "wpe": params["wpe"]}
+    head = {"ln_f": params["ln_f"]}
+    if "lm_head" in params:
+        head["lm_head"] = params["lm_head"]
+    return {"embed": embed, "blocks": staged, "head": head}
+
+
+class PipelinedGPT:
+    """Model-like wrapper running GPT with pipeline-parallel blocks.
+
+    Drop-in for the places auto_accelerate touches a model:
+    ``.config``, ``.init_params`` (returns the stage-stacked layout)
+    and ``.apply({"params": pp}, tokens)``.  Constraints: uniform
+    blocks (no MoE interleave) and no nested sequence-parallel
+    attention (both need their own shard_map).
+    """
+
+    def __init__(
+        self, inner: "GPT", num_stages: int, num_microbatches: int,
+        batch_axis=("data", "fsdp"),
+    ):
+        if inner.config.moe_experts > 0:
+            raise ValueError(
+                "pipeline requires uniform blocks; MoE interleave is "
+                "not supported (shard MoE over the expert axis instead)"
+            )
+        if inner.config.attention_impl in ("ring", "ulysses",
+                                           "ulysses_flash"):
+            raise ValueError(
+                "sequence-parallel attention cannot nest inside the "
+                "pipeline shard_map"
+            )
+        self.inner = inner
+        self.config = inner.config
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.batch_axis = batch_axis
+
+    def init_params(self, rng, batch_size: int = 2, seq_len: int = 0):
+        params = self.inner.init_params(rng, batch_size, seq_len)
+        return partition_pipeline_params(
+            params, self.num_stages, self.config.num_layers
+        )
+
+    def apply(self, variables, tokens: jax.Array) -> jax.Array:
+        from dlrover_tpu.parallel.mesh import get_global_mesh
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+        pp = variables["params"]
+        cfg = self.config
+        mesh = get_global_mesh()
+        b, s = tokens.shape
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        )
+        wpe = nn.Embed(
+            cfg.max_seq_len, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        )
+        x = wte.apply({"params": pp["embed"]["wte"]}, tokens)
+        x = x + wpe.apply(
+            {"params": pp["embed"]["wpe"]}, jnp.arange(s)[None]
+        )
+
+        block = Block(cfg)
+        if cfg.remat:
+            remat_apply = jax.checkpoint(
+                block.apply, prevent_cse=False
+            )
+        else:
+            remat_apply = block.apply
+
+        def stage_fn(stage_params, h):
+            # stage_params leaves: [L/S, ...]; scan the stage's blocks
+            def body(h, bp):
+                return remat_apply({"params": bp}, h), None
+
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        x = pipeline_apply(
+            stage_fn, pp["blocks"], x, mesh,
+            num_microbatches=self.num_microbatches,
+            batch_axis=self.batch_axis,
+        )
+        x = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": pp["head"]["ln_f"]}, x
+        )
+        if cfg.tie_embeddings:
+            logits = wte.apply(
+                {"params": pp["embed"]["wte"]},
+                x.astype(cfg.dtype),
+                method="attend",
+            )
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+            ).apply({"params": pp["head"]["lm_head"]}, x)
+        return logits.astype(jnp.float32)
+
+
+def to_pipelined(
+    model: "GPT", num_stages: int, num_microbatches: int,
+    batch_axis=("data", "fsdp"),
+) -> PipelinedGPT:
+    """auto_accelerate protocol hook (build_from_plan calls this when
+    the plan's mesh has pipeline > 1)."""
+    return PipelinedGPT(model, num_stages, num_microbatches, batch_axis)
+
+
+GPT.to_pipelined = to_pipelined

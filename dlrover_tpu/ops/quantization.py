@@ -4,9 +4,13 @@ Reference: ATorch's CUDA quantization kernels powering the low-bit
 optimizer family (``atorch/atorch/ops/csrc/quantization/{quantize,
 dequantize,quantization_optimizer}.cu``, ~4.6k LoC; SURVEY.md §2.7).
 TPU equivalent: symmetric absmax int8 with one fp32 scale per block of
-``block_size`` elements, as Pallas kernels (interpret mode on CPU).
-Used by :mod:`dlrover_tpu.optim.low_bit` to store Adam moments in 1/4
-the HBM.
+``block_size`` elements.  All kernels are **gridded** over row tiles so
+VMEM usage is bounded regardless of tensor size (a 124M-param leaf is
+~500 MB in fp32 — far beyond the ~16 MB VMEM budget of one ungridded
+call).  ``fused_qadam_step`` is the TPU analog of the reference's
+``quantization_optimizer.cu``: dequant -> Adam math -> requant in one
+VMEM round trip per tile, so the moments never materialize in HBM at
+fp32.  Used by :mod:`dlrover_tpu.optim.low_bit`.
 """
 
 import functools
@@ -15,13 +19,21 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK = 2048  # elements per scale block (multiple of 128 lanes)
+ROW_TILE = 128        # rows per grid step: tile fp32 bytes = 128*2048*4 = 1 MB
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _pad_rows(tiles: jax.Array, row_tile: int) -> Tuple[jax.Array, int]:
+    rows = tiles.shape[0]
+    padded = -(-rows // row_tile) * row_tile
+    if padded != rows:
+        tiles = jnp.pad(tiles, ((0, padded - rows), (0, 0)))
+    return tiles, rows
 
 
 def _quant_kernel(x_ref, q_ref, scale_ref):
@@ -37,40 +49,170 @@ def _dequant_kernel(q_ref, scale_ref, out_ref):
     out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[:]
 
 
+def _row_spec(block: int):
+    return pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0))
+
+
+def _scale_spec():
+    return pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _quantize_tiles(tiles: jax.Array, block_size: int):
+    padded, rows = _pad_rows(tiles, ROW_TILE)
+    grid = padded.shape[0] // ROW_TILE
+    q, scales = pl.pallas_call(
+        _quant_kernel,
+        grid=(grid,),
+        in_specs=[_row_spec(block_size)],
+        out_specs=[_row_spec(block_size), _scale_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(padded.shape, jnp.int8),
+            jax.ShapeDtypeStruct((padded.shape[0], 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(padded)
+    return q[:rows], scales[:rows]
+
+
+def to_block_tiles(x: jax.Array, block_size: int) -> jax.Array:
+    """Flatten + zero-pad ``x`` to the [rows, block_size] layout every
+    kernel here operates on."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    rows = -(-flat.size // block_size)
+    pad = rows * block_size - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat.reshape(rows, block_size)
+
+
 def quantize_blockwise(
     x: jax.Array, block_size: int = DEFAULT_BLOCK
 ) -> Tuple[jax.Array, jax.Array, Tuple[int, ...]]:
     """Flatten + pad to [rows, block_size]; returns (int8 values,
     fp32 scales [rows, 1], original shape)."""
     shape = x.shape
-    flat = x.reshape(-1).astype(jnp.float32)
-    n = flat.size
-    rows = -(-n // block_size)
-    pad = rows * block_size - n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-    tiles = flat.reshape(rows, block_size)
-
-    q, scales = pl.pallas_call(
-        _quant_kernel,
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, block_size), jnp.int8),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-        ],
-        interpret=_interpret(),
-    )(tiles)
+    tiles = to_block_tiles(x, block_size)
+    q, scales = _quantize_tiles(tiles, block_size)
     return q, scales, shape
+
+
+@jax.jit
+def _dequantize_tiles(q: jax.Array, scales: jax.Array) -> jax.Array:
+    block = q.shape[1]
+    q_p, rows = _pad_rows(q, ROW_TILE)
+    s_p, _ = _pad_rows(scales, ROW_TILE)
+    grid = q_p.shape[0] // ROW_TILE
+    out = pl.pallas_call(
+        _dequant_kernel,
+        grid=(grid,),
+        in_specs=[_row_spec(block), _scale_spec()],
+        out_specs=_row_spec(block),
+        out_shape=jax.ShapeDtypeStruct(q_p.shape, jnp.float32),
+        interpret=_interpret(),
+    )(q_p, s_p)
+    return out[:rows]
 
 
 def dequantize_blockwise(
     q: jax.Array, scales: jax.Array, shape: Tuple[int, ...]
 ) -> jax.Array:
-    out = pl.pallas_call(
-        _dequant_kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
-        interpret=_interpret(),
-    )(q, scales)
+    out = _dequantize_tiles(q, scales)
     n = 1
     for s in shape:
         n *= s
     return out.reshape(-1)[:n].reshape(shape)
+
+
+# -- fused quantized-optimizer step -----------------------------------------
+
+
+def _qadam_kernel(
+    hyp_ref, g_ref, p_ref, qmu_ref, mus_ref, qnu_ref, nus_ref,
+    upd_ref, qmu_out, mus_out, qnu_out, nus_out,
+    *, b1: float, b2: float, eps: float, lr: float, wd: float,
+):
+    """One VMEM pass: dequant moments, Adam math, requant, emit update.
+
+    ``hyp`` carries the traced bias corrections [bc1, bc2] (they depend
+    on the step count); the python-float hyperparams are baked in.
+    """
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    mu = qmu_ref[:].astype(jnp.float32) * mus_ref[:]
+    nu = qnu_ref[:].astype(jnp.float32) * nus_ref[:]
+    mu = b1 * mu + (1.0 - b1) * g
+    nu = b2 * nu + (1.0 - b2) * g * g
+    bc1 = hyp_ref[0, 0]
+    bc2 = hyp_ref[0, 1]
+    m_hat = mu / bc1
+    v_hat = nu / bc2
+    upd_ref[:] = -lr * (m_hat / (jnp.sqrt(v_hat) + eps) + wd * p)
+    mu_absmax = jnp.max(jnp.abs(mu), axis=-1, keepdims=True)
+    mu_scale = jnp.maximum(mu_absmax / 127.0, 1e-12)
+    qmu_out[:] = jnp.clip(
+        jnp.round(mu / mu_scale), -127, 127
+    ).astype(jnp.int8)
+    mus_out[:] = mu_scale
+    nu_absmax = jnp.max(jnp.abs(nu), axis=-1, keepdims=True)
+    nu_scale = jnp.maximum(nu_absmax / 127.0, 1e-12)
+    qnu_out[:] = jnp.clip(
+        jnp.round(nu / nu_scale), -127, 127
+    ).astype(jnp.int8)
+    nus_out[:] = nu_scale
+
+
+@functools.partial(
+    jax.jit, static_argnames=("b1", "b2", "eps", "lr", "wd")
+)
+def fused_qadam_step(
+    g_tiles: jax.Array,     # f32 [rows, block]
+    p_tiles: jax.Array,     # f32 [rows, block]
+    q_mu: jax.Array,        # int8 [rows, block]
+    mu_scales: jax.Array,   # f32 [rows, 1]
+    q_nu: jax.Array,
+    nu_scales: jax.Array,
+    bias_corr: jax.Array,   # f32 [1, 2] = [1-b1^t, 1-b2^t]
+    *,
+    b1: float, b2: float, eps: float, lr: float, wd: float,
+):
+    """Returns (upd_tiles, q_mu', mu_scales', q_nu', nu_scales')."""
+    block = g_tiles.shape[1]
+    g_p, rows = _pad_rows(g_tiles, ROW_TILE)
+    p_p, _ = _pad_rows(p_tiles, ROW_TILE)
+    qmu_p, _ = _pad_rows(q_mu, ROW_TILE)
+    mus_p, _ = _pad_rows(mu_scales, ROW_TILE)
+    qnu_p, _ = _pad_rows(q_nu, ROW_TILE)
+    nus_p, _ = _pad_rows(nu_scales, ROW_TILE)
+    grid = g_p.shape[0] // ROW_TILE
+    padded_rows = g_p.shape[0]
+    hyp_spec = pl.BlockSpec((1, 2), lambda i: (0, 0))
+    kernel = functools.partial(
+        _qadam_kernel, b1=b1, b2=b2, eps=eps, lr=lr, wd=wd
+    )
+    upd, qmu2, mus2, qnu2, nus2 = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            hyp_spec,
+            _row_spec(block), _row_spec(block),
+            _row_spec(block), _scale_spec(),
+            _row_spec(block), _scale_spec(),
+        ],
+        out_specs=[
+            _row_spec(block),
+            _row_spec(block), _scale_spec(),
+            _row_spec(block), _scale_spec(),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_rows, block), jnp.float32),
+            jax.ShapeDtypeStruct((padded_rows, block), jnp.int8),
+            jax.ShapeDtypeStruct((padded_rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((padded_rows, block), jnp.int8),
+            jax.ShapeDtypeStruct((padded_rows, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(bias_corr, g_p, p_p, qmu_p, mus_p, qnu_p, nus_p)
+    return (
+        upd[:rows], qmu2[:rows], mus2[:rows], qnu2[:rows], nus2[:rows]
+    )

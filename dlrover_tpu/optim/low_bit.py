@@ -18,7 +18,9 @@ import optax
 from dlrover_tpu.ops.quantization import (
     DEFAULT_BLOCK,
     dequantize_blockwise,
+    fused_qadam_step,
     quantize_blockwise,
+    to_block_tiles,
 )
 
 
@@ -73,21 +75,27 @@ def q_adamw(
         count = state.count + 1
         bc1 = 1 - b1**count.astype(jnp.float32)
         bc2 = 1 - b2**count.astype(jnp.float32)
+        bias_corr = jnp.stack([bc1, bc2]).reshape(1, 2)
+
+        def to_tiles(x):
+            return to_block_tiles(x, block_size)
 
         def leaf_update(g, qmu, qnu, p):
-            g = g.astype(jnp.float32)
-            mu = _dequant(qmu, g.shape)
-            nu = _dequant(qnu, g.shape)
-            mu = b1 * mu + (1 - b1) * g
-            nu = b2 * nu + (1 - b2) * g * g
-            m_hat = mu / bc1
-            v_hat = nu / bc2
-            upd = -learning_rate * (
-                m_hat / (jnp.sqrt(v_hat) + eps)
-                + weight_decay * p.astype(jnp.float32)
+            # single fused Pallas pass: dequant moments -> Adam math ->
+            # requant + update, moments never hit HBM at fp32
+            # (reference: quantization_optimizer.cu)
+            upd_t, qm, ms, qn, ns = fused_qadam_step(
+                to_tiles(g), to_tiles(p),
+                qmu.values, qmu.scales, qnu.values, qnu.scales,
+                bias_corr,
+                b1=b1, b2=b2, eps=eps, lr=learning_rate,
+                wd=weight_decay,
             )
-            return upd.astype(p.dtype), _quant(mu, block_size), _quant(
-                nu, block_size
+            upd = upd_t.reshape(-1)[: p.size].reshape(p.shape)
+            return (
+                upd.astype(p.dtype),
+                QMoment(values=qm, scales=ms),
+                QMoment(values=qn, scales=ns),
             )
 
         flat_g, treedef = jax.tree_util.tree_flatten(grads)

@@ -37,14 +37,18 @@ def pipeline_apply(
     mesh,
     num_microbatches: int,
     axis: str = "pipeline",
+    batch_axis=None,
 ):
     """Run ``stage_fn`` as a pipeline over the mesh's pipeline axis.
 
     ``stage_fn(stage_params, activation) -> activation`` must preserve
     the activation shape (classic transformer-block stages).
     ``stacked_params`` leaves have a leading dim == num_stages (sharded
-    over ``axis``); ``x`` is [batch, ...] with batch divisible by
-    ``num_microbatches``.
+    over ``axis``); ``x`` is [batch, ...] with the per-data-shard batch
+    divisible by ``num_microbatches``.  ``batch_axis`` (mesh axis name
+    or tuple of names) shards ``x``'s batch dim so each data-parallel
+    row pipelines only its own slice — without it the activations are
+    replicated on every device.
     """
     num_stages = mesh.shape[axis]
     if num_stages == 1:
@@ -52,16 +56,26 @@ def pipeline_apply(
             jax.tree.map(lambda p: p[0], stacked_params), x
         )
     b = x.shape[0]
-    if b % num_microbatches:
-        raise ValueError(
-            f"batch {b} not divisible by {num_microbatches} microbatches"
+    dp = 1
+    if batch_axis is not None:
+        names = (
+            (batch_axis,) if isinstance(batch_axis, str) else batch_axis
         )
-    mb = b // num_microbatches
-    micro = x.reshape((num_microbatches, mb) + x.shape[1:])
+        for name in names:
+            dp *= mesh.shape[name]
+    if b % (num_microbatches * dp):
+        raise ValueError(
+            f"batch {b} not divisible by {num_microbatches} "
+            f"microbatches x {dp} data shards"
+        )
 
-    def local(params_stage, micro_local):
+    def local(params_stage, x_local):
         # params_stage leaves: [1, ...] (this device's stage)
         params = jax.tree.map(lambda p: p[0], params_stage)
+        mb = x_local.shape[0] // num_microbatches
+        micro_local = x_local.reshape(
+            (num_microbatches, mb) + x_local.shape[1:]
+        )
         stage = jax.lax.axis_index(axis)
         total_steps = num_microbatches + num_stages - 1
         perm = [(i, i + 1) for i in range(num_stages - 1)]
@@ -101,16 +115,20 @@ def pipeline_apply(
         )
         # only the last stage holds results; psum replicates them
         mask = (stage == num_stages - 1).astype(out_buf.dtype)
-        return jax.lax.psum(out_buf * mask, axis)
+        out_local = jax.lax.psum(out_buf * mask, axis)
+        return out_local.reshape(
+            (x_local.shape[0],) + x_local.shape[1:]
+        )
 
+    x_spec = P(batch_axis) if batch_axis is not None else P()
     out = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(axis), stacked_params),
-            P(),  # microbatches replicated; stage 0 feeds them
+            x_spec,  # stage 0 feeds its data shard's microbatches
         ),
-        out_specs=P(),
+        out_specs=x_spec,
         check_vma=False,
-    )(stacked_params, micro)
-    return out.reshape((b,) + x.shape[1:])
+    )(stacked_params, x)
+    return out

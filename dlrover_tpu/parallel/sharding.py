@@ -132,6 +132,16 @@ def replicated_rules() -> PartitionRules:
     return PartitionRules(rules=[], default=())
 
 
+def pipeline_rules() -> PartitionRules:
+    """Stage-stacked block params (``blocks/...`` leaves with leading
+    [stage, layer/stage] dims) shard dim 0 over the pipeline axis;
+    embed/head replicate (reference: per-stage module placement in the
+    PiPPy compiler, distributed_pippy_compiler.py:541)."""
+    return PartitionRules(
+        rules=[(r"(^|/)blocks/", ("pipeline",))], default=()
+    )
+
+
 def fsdp_rules(min_size_divisor: int = 1) -> PartitionRules:
     """ZeRO-3 parity: shard the largest dim of every weight over
     ``fsdp``.  Biases/norms stay replicated (they are tiny and GSPMD

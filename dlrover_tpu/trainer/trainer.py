@@ -159,6 +159,7 @@ class Trainer:
         step = start_step
         metrics_out: Dict[str, float] = {}
         batch = first
+        loss = float("nan")
         save_storage_steps = (
             self.args.save_storage_steps or self.args.save_steps
         )
@@ -185,8 +186,14 @@ class Trainer:
             except StopIteration:
                 data_iter = iter(self.train_data)
                 batch = next(data_iter)
-        # final storage save
+        # final storage save; flush in-flight snapshots first so the
+        # save cannot be skipped as busy, then flush it too so a
+        # process exit right after train() cannot lose it
+        if self._checkpointer is not None:
+            self._checkpointer.wait()
         self._save(step, True)
+        if self._checkpointer is not None:
+            self._checkpointer.wait()
         metrics_out.update(
             {"final_loss": loss, "steps": step}
         )

@@ -12,6 +12,14 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("DLROVER_LOG_LEVEL", "WARNING")
 
+# per-run IPC/shm namespace so a test run never clobbers the shm
+# segments of a concurrently running job (e.g. the driver's bench)
+import tempfile  # noqa: E402
+
+os.environ["DLROVER_SHARED_DIR"] = os.path.join(
+    tempfile.mkdtemp(prefix="dlrover_test_"), "sockets"
+)
+
 # The axon TPU plugin registers itself regardless of the env var, so
 # pin the platform through the config API too.
 import jax  # noqa: E402
