@@ -15,7 +15,6 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from dlrover_tpu.accel.analyser import analyse, fits_in_hbm
 from dlrover_tpu.accel.model_context import ModelContext
 from dlrover_tpu.accel.opt_lib import OptimizationLibrary
 from dlrover_tpu.accel.strategy import AccelPlan, Strategy
@@ -222,43 +221,9 @@ def _wants_model(fn) -> bool:
 
 
 # ---------------------------------------------------------------------------
-# strategy search (reference: AccelerationEngine + combination_sg,
-# auto/engine/)
+# strategy search — see strategy_search.py (reference:
+# AccelerationEngine + combination_sg + bayes_opt_sg, auto/engine/)
 # ---------------------------------------------------------------------------
-
-
-def candidate_strategies(
-    context: ModelContext, num_devices: int
-) -> List[Strategy]:
-    """Combination strategy generation pruned by the memory model
-    (reference: combination_sg.py + analyser features)."""
-    analysis = analyse(context)
-    cands: List[Strategy] = []
-
-    def add(opts, fsdp=1, tensor=1, remat=False):
-        if fits_in_hbm(analysis, fsdp, tensor, remat):
-            cands.append(Strategy(opts=opts))
-
-    add([("parallel_mode", {}), ("amp_native", {})])
-    add([("zero1", {}), ("amp_native", {})], fsdp=num_devices)
-    add([("fsdp", {}), ("amp_native", {})], fsdp=num_devices)
-    add(
-        [("fsdp", {}), ("amp_native", {}), ("checkpoint", {})],
-        fsdp=num_devices, remat=True,
-    )
-    if num_devices % 2 == 0 and num_devices > 1:
-        add(
-            [
-                ("mixed_parallel", {"tensor": 2, "fsdp": 1,
-                                    "data": -1}),
-                ("amp_native", {}),
-            ],
-            tensor=2,
-        )
-    # always at least pure DP as a fallback
-    if not cands:
-        cands.append(Strategy(opts=[("parallel_mode", {})]))
-    return cands
 
 
 def auto_accelerate(
@@ -292,24 +257,24 @@ def auto_accelerate(
         logger.info("loaded strategy %s", strategy.names())
 
     if strategy is None:
-        cands = candidate_strategies(context, len(devices))
-        if dry_run_candidates and len(cands) > 1:
-            from dlrover_tpu.accel.dry_runner import profile_plan
+        from dlrover_tpu.accel.strategy_search import (
+            generate_candidates,
+            search_strategy,
+        )
 
-            best, best_time = None, float("inf")
-            for cand in cands:
-                plan = lib.apply_strategy(cand, context)
-                plan.grad_accum = grad_accum
-                result = profile_plan(plan, context)
-                logger.info(
-                    "candidate %s: ok=%s step=%.4fs",
-                    cand.names(), result.ok, result.step_time_s,
-                )
-                if result.ok and result.step_time_s < best_time:
-                    best, best_time = cand, result.step_time_s
-            strategy = best or cands[0]
+        if dry_run_candidates:
+            result = search_strategy(
+                context, len(devices), devices=devices,
+                grad_accums=(grad_accum,) if grad_accum > 1
+                else (1, 2),
+            )
+            strategy = result.best.strategy
+            if grad_accum == 1:
+                grad_accum = result.best.grad_accum
         else:
-            strategy = cands[0]
+            strategy = generate_candidates(
+                context, len(devices)
+            )[0].strategy
         logger.info("selected strategy %s", strategy.names())
 
     if save_strategy:

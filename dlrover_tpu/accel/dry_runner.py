@@ -29,13 +29,14 @@ class DryRunResult:
 
 
 def profile_plan(
-    plan, context, profile_steps: int = 3
+    plan, context, profile_steps: int = 3, devices=None
 ) -> DryRunResult:
-    """Build + run the plan's train step on the current devices."""
+    """Build + run the plan's train step on the given (default: all)
+    devices."""
     from dlrover_tpu.accel.accelerate import build_from_plan
 
     try:
-        built = build_from_plan(plan, context)
+        built = build_from_plan(plan, context, devices=devices)
     except Exception as e:  # noqa: BLE001 - any build error fails cand.
         logger.info("plan build failed: %s", e)
         return DryRunResult(ok=False, error=str(e))

@@ -1,0 +1,223 @@
+"""Strategy search: mesh factorizations x remat x grad-accum, HBM
+pruned, dry-run ranked, BO-guided under a budget.
+
+Reference: the acceleration-engine strategy generation —
+exhaustive combination (``atorch/auto/engine/sg_algo/combination_sg.py``)
+plus Bayesian optimization (``bayes_opt_sg.py`` / vendored HEBO) —
+conducted through the engine's task queue.  The TPU version generates
+candidate (data, fsdp, tensor) mesh factorizations with remat and
+gradient-accumulation knobs, prunes by the analyser's HBM model, and
+ranks the survivors with real dry-run step timings.  When there are
+more candidates than the dry-run budget, a GP/EI optimizer
+(:mod:`dlrover_tpu.brain.bo`) picks which to measure next.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.accel.analyser import analyse, fits_in_hbm
+from dlrover_tpu.accel.strategy import Strategy
+from dlrover_tpu.brain.bo import BayesianOptimizer, Parameter
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class Candidate:
+    strategy: Strategy
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    remat: bool = False
+    grad_accum: int = 1
+    step_time_s: Optional[float] = None
+
+    def features(self) -> Dict[str, float]:
+        return {
+            "log_fsdp": math.log2(self.fsdp),
+            "log_tensor": math.log2(self.tensor),
+            "remat": float(self.remat),
+            "log_accum": math.log2(self.grad_accum),
+        }
+
+    def describe(self) -> str:
+        return (
+            f"data{self.data}xfsdp{self.fsdp}xtp{self.tensor}"
+            f"{'+remat' if self.remat else ''}"
+            f"{f'+ga{self.grad_accum}' if self.grad_accum > 1 else ''}"
+        )
+
+
+def mesh_factorizations(num_devices: int) -> List[Tuple[int, int, int]]:
+    """(data, fsdp, tensor) triples with product == num_devices."""
+    out = []
+    for fsdp in _divisors(num_devices):
+        for tensor in _divisors(num_devices // fsdp):
+            data = num_devices // (fsdp * tensor)
+            out.append((data, fsdp, tensor))
+    return out
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _build_strategy(
+    data: int, fsdp: int, tensor: int, remat: bool, grad_accum: int,
+) -> Strategy:
+    opts: List[Tuple[str, Dict]] = []
+    if tensor > 1:
+        opts.append((
+            "mixed_parallel",
+            {"tensor": tensor, "fsdp": fsdp, "data": -1},
+        ))
+    elif fsdp > 1:
+        opts.append(("fsdp", {"size": fsdp}))
+    else:
+        opts.append(("parallel_mode", {}))
+    opts.append(("amp_native", {}))
+    if remat:
+        opts.append(("checkpoint", {}))
+    return Strategy(opts=opts)
+
+
+def generate_candidates(
+    context,
+    num_devices: int,
+    grad_accums: Tuple[int, ...] = (1, 2),
+    max_tensor: int = 8,
+) -> List[Candidate]:
+    """Combination generation pruned by the memory model (reference:
+    combination_sg.py)."""
+    analysis = analyse(context)
+    batch = max(1, analysis.batch_size)
+    cands: List[Candidate] = []
+    seen = set()
+    for data, fsdp, tensor in mesh_factorizations(num_devices):
+        if tensor > max_tensor:
+            continue
+        for remat in (False, True):
+            if not fits_in_hbm(analysis, fsdp, tensor, remat):
+                continue
+            for ga in grad_accums:
+                if batch % (ga * max(1, data * fsdp)):
+                    continue
+                key = (data, fsdp, tensor, remat, ga)
+                if key in seen:
+                    continue
+                seen.add(key)
+                cands.append(Candidate(
+                    strategy=_build_strategy(
+                        data, fsdp, tensor, remat, ga
+                    ),
+                    data=data, fsdp=fsdp, tensor=tensor,
+                    remat=remat, grad_accum=ga,
+                ))
+    if not cands:
+        # nothing fits the model: fall back to the most
+        # memory-frugal plan and let the dry run surface the OOM
+        logger.warning(
+            "no candidate passed the HBM model; falling back to "
+            "fsdp x remat"
+        )
+        cands.append(Candidate(
+            strategy=_build_strategy(
+                1, num_devices, 1, True, grad_accums[0]
+            ),
+            data=1, fsdp=num_devices, tensor=1, remat=True,
+            grad_accum=grad_accums[0],
+        ))
+    return cands
+
+
+@dataclass
+class SearchResult:
+    best: Candidate
+    evaluated: List[Candidate] = field(default_factory=list)
+
+
+def search_strategy(
+    context,
+    num_devices: int,
+    devices=None,
+    dry_run_budget: int = 6,
+    grad_accums: Tuple[int, ...] = (1, 2),
+    seed: int = 0,
+) -> SearchResult:
+    """Generate, prune, and dry-run rank; BO picks what to measure
+    when candidates exceed the budget (reference: bayes_opt_sg.py)."""
+    from dlrover_tpu.accel.dry_runner import profile_plan
+    from dlrover_tpu.accel.opt_lib import OptimizationLibrary
+
+    lib = OptimizationLibrary()
+    cands = generate_candidates(context, num_devices, grad_accums)
+    logger.info(
+        "strategy search: %d candidates after HBM pruning: %s",
+        len(cands), [c.describe() for c in cands],
+    )
+
+    def evaluate(cand: Candidate) -> float:
+        plan = lib.apply_strategy(cand.strategy, context)
+        plan.grad_accum = cand.grad_accum
+        result = profile_plan(plan, context, devices=devices)
+        cand.step_time_s = (
+            result.step_time_s if result.ok else float("inf")
+        )
+        logger.info(
+            "candidate %s: ok=%s step=%.4fs",
+            cand.describe(), result.ok, result.step_time_s,
+        )
+        return cand.step_time_s
+
+    if len(cands) <= dry_run_budget:
+        for cand in cands:
+            evaluate(cand)
+        measured = [c for c in cands if c.step_time_s is not None]
+    else:
+        params = [
+            Parameter("log_fsdp", 0.0, math.log2(num_devices)),
+            Parameter("log_tensor", 0.0, math.log2(num_devices)),
+            Parameter("remat", 0.0, 1.0),
+            Parameter("log_accum", 0.0, math.log2(max(grad_accums))),
+        ]
+        bo = BayesianOptimizer(params, seed=seed)
+        rng = np.random.default_rng(seed)
+        remaining = list(cands)
+        measured = []
+        # seed with two random picks, then BO expected improvement
+        for i in range(min(dry_run_budget, len(cands))):
+            if i < 2:
+                pick = remaining.pop(
+                    int(rng.integers(len(remaining)))
+                )
+            else:
+                suggestion = bo.suggest(1)[0]
+                pick = min(
+                    remaining,
+                    key=lambda c: sum(
+                        (c.features()[k] - suggestion[k]) ** 2
+                        for k in suggestion
+                    ),
+                )
+                remaining.remove(pick)
+            t = evaluate(pick)
+            measured.append(pick)
+            reward = -t if math.isfinite(t) else -1e6
+            bo.observe(pick.features(), reward)
+
+    runnable = [
+        c for c in measured
+        if c.step_time_s is not None and math.isfinite(c.step_time_s)
+    ]
+    if not runnable:
+        raise RuntimeError(
+            "strategy search: no candidate ran successfully"
+        )
+    best = min(runnable, key=lambda c: c.step_time_s)
+    logger.info(
+        "strategy search: best %s (%.4fs/step)",
+        best.describe(), best.step_time_s,
+    )
+    return SearchResult(best=best, evaluated=measured)

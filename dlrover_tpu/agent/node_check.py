@@ -1,24 +1,31 @@
-"""Node health-check payload: per-chip compute benchmark + cross-node
-sync probe.
+"""Node health-check payload: per-chip compute benchmark + fabric
+probe.
 
 Reference: ``dlrover/trainer/torch/node_check/{utils,nvidia_gpu}.py``
-(matmul + 2^24-float allreduce per round) driven by
-``NodeCheckElasticAgent`` (``elastic_agent/torch/training.py:864``).
-On TPU the equivalent per-chip probe is a jitted bf16 matmul on every
-local device (exercises MXU + HBM); the cross-node probe is a
-KV-store barrier timed against the master (stand-in for an ICI/DCN
-collective when no global runtime is up — the real collective path is
-exercised by training itself).  Elapsed time is reported to the
-master's NetworkCheckRendezvousManager, which isolates fault nodes and
-stragglers (>2x median, rdzv_manager.py:550).
+(matmul + 2^24-float allreduce per round, ``utils.py:57-105``) driven
+by ``NodeCheckElasticAgent`` (``elastic_agent/torch/training.py:864``).
+On TPU the per-chip probe is a jitted bf16 matmul on every local
+device (exercises MXU + HBM); the fabric probe is a timed
+psum + ring-ppermute collective over every visible device — riding
+ICI within a slice, DCN across slices.  A KV-store barrier against
+the master synchronizes rounds and catches dead peers (its wait time
+is excluded from the reported number so a slow peer cannot mask
+itself).  Elapsed time feeds the master's
+NetworkCheckRendezvousManager, which isolates fault nodes and
+stragglers (>2x median, rdzv_manager.py:550) over two pairwise
+regrouping rounds.
 
-Fault injection: ``MOCK_ERR_RANK`` makes the matching node rank raise,
-mirroring ``node_check/utils.py:49 mock_error()``.
+Fault injection: ``MOCK_ERR_RANK`` makes the matching node rank raise
+(mirrors ``node_check/utils.py:49 mock_error()``);
+``MOCK_STRAGGLER_RANK``/``MOCK_STRAGGLER_DELAY`` make a rank slow —
+the chaos experiment of ``docs/tech_report/fault_tolerance_exps.md``.
 """
 
 import os
 import time
 from typing import Optional
+
+import numpy as np
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.constants import NodeEnv
@@ -30,6 +37,17 @@ def mock_error():
     err_rank = os.getenv(NodeEnv.MOCK_ERR_RANK, "")
     if err_rank and int(err_rank) == int(os.getenv(NodeEnv.NODE_RANK, "0")):
         raise RuntimeError(f"mock error on rank {err_rank}")
+
+
+def mock_straggle():
+    """Sleep if this node rank is marked slow (straggler injection)."""
+    slow_rank = os.getenv("MOCK_STRAGGLER_RANK", "")
+    if slow_rank and int(slow_rank) == int(
+        os.getenv(NodeEnv.NODE_RANK, "0")
+    ):
+        delay = float(os.getenv("MOCK_STRAGGLER_DELAY", "3.0"))
+        logger.info("injected straggle: sleeping %.1fs", delay)
+        time.sleep(delay)
 
 
 def bm_chip_matmul(size: int = 1024, rounds: int = 8) -> float:
@@ -63,16 +81,71 @@ def bm_chip_matmul(size: int = 1024, rounds: int = 8) -> float:
     return elapsed
 
 
+def bm_collective_probe(
+    payload_floats: int = 1 << 22, rounds: int = 2,
+) -> Optional[float]:
+    """Timed psum + ring ppermute over every visible device.
+
+    The honest fabric probe (reference: ``bm_allreduce``/
+    ``bm_allgather``, node_check/utils.py:57-105): the payload crosses
+    ICI (intra-slice) / DCN (inter-slice) links, so a degraded link or
+    chip inflates this node's elapsed time.  Returns None when fewer
+    than two devices are visible (no local fabric to probe; the
+    master-mediated barrier in ``run_node_check`` still provides
+    cross-node liveness).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return None
+    mesh = Mesh(np.array(devices), ("probe",))
+    per = max(128, payload_floats // n)
+    x = jax.device_put(
+        jnp.ones((n, per), jnp.float32),
+        NamedSharding(mesh, P("probe")),
+    )
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(block):
+        s = jax.lax.psum(block, "probe")       # allreduce
+        return jax.lax.ppermute(s, "probe", perm)  # neighbor links
+
+    fn = jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=P("probe"),
+            out_specs=P("probe"),
+        )
+    )
+    out = fn(x)
+    float(out[0, 0])  # force execution (tunnel-safe sync)
+    start = time.perf_counter()
+    for _ in range(rounds):
+        out = fn(out / n)
+    float(out[0, 0])
+    elapsed = time.perf_counter() - start
+    logger.info(
+        "collective probe: %d devices, %d floats, %d rounds in %.3fs",
+        n, per * n, rounds, elapsed,
+    )
+    return elapsed
+
+
 def bm_sync_barrier(
     client: MasterClient, round_id: int, world_size: int,
     timeout: float = 300.0,
 ) -> float:
-    """Timed all-nodes barrier through the master KV store.
+    """All-nodes barrier through the master KV store.
 
-    Measures how long this node waits for every peer to arrive —
-    a slow peer inflates everyone's elapsed time except its own,
-    which combined with the matmul timing lets the master's 2-round
-    pairwise regrouping isolate the slow node.
+    A liveness/sync gate, not a performance number: it synchronizes
+    check rounds across nodes and raises when a peer never arrives
+    (dead node -> this node reports abnormal).  Its wait time is
+    deliberately NOT part of the reported elapsed — a slow peer would
+    inflate every healthy node's number and mask the actual straggler.
     """
     key = f"node_check_barrier_{round_id}"
     start = time.perf_counter()
@@ -98,8 +171,22 @@ def run_node_check(
     """
     client = client or MasterClient.singleton()
     mock_error()
-    elapsed = bm_chip_matmul(size=matmul_size)
+    # one timer over the whole work phase so injected or real chip
+    # slowness lands in THIS node's number (the reference reports
+    # per-node work time, node_check/utils.py:25-46)
+    work_start = time.perf_counter()
+    mock_straggle()
+    bm_chip_matmul(size=matmul_size)
+    bm_collective_probe()
+    elapsed = time.perf_counter() - work_start
     if world_size > 1:
-        elapsed += bm_sync_barrier(client, round_id, world_size)
+        # master-mediated barrier: synchronizes the round across nodes
+        # (and fails when a peer is dead), but its wait time is NOT
+        # part of this node's elapsed — a slow peer would otherwise
+        # inflate every healthy node's number and mask the straggler
+        # (the reference reports per-node work time too,
+        # node_check/utils.py:25-46)
+        wait = bm_sync_barrier(client, round_id, world_size)
+        logger.info("barrier wait %.3fs (not counted)", wait)
     logger.info("node check elapsed %.3fs", elapsed)
     return elapsed

@@ -37,7 +37,7 @@ from dlrover_tpu.checkpoint.shm_handler import (
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
-from dlrover_tpu.common.storage import PosixDiskStorage
+from dlrover_tpu.common.storage import get_checkpoint_storage
 
 
 class CheckpointEngine:
@@ -98,7 +98,7 @@ class CheckpointEngine:
             SharedQueue(EVENT_QUEUE, create=False)
             if self._rank == 0 else None
         )
-        self._storage = PosixDiskStorage()
+        self._storage = get_checkpoint_storage(path=checkpoint_dir)
         self._notified_agent = False
         self._deletion_keep_latest = deletion_keep_latest
         self._cached_step = -1
@@ -161,12 +161,18 @@ class CheckpointEngine:
 
     # -- save ---------------------------------------------------------------
 
-    def save_to_memory(self, step: int, state_dict, path: str = "") -> bool:
+    def save_to_memory(
+        self, step: int, state_dict, path: str = "",
+        block_lock: bool = False,
+    ) -> bool:
         """Synchronous part of a flash save: device->host copy into
-        shm under the shm lock.  Non-blocking lock: if the agent is
-        still persisting the previous snapshot, skip this save rather
-        than stall training (reference: save_state_dict_to_memory,
-        engine.py:291)."""
+        shm under the shm lock.  Non-blocking lock by default: if the
+        agent is still persisting the previous snapshot, skip this
+        save rather than stall training (reference:
+        save_state_dict_to_memory, engine.py:291).  The async writer
+        thread passes ``block_lock=True`` — it is off the training
+        path, so waiting for the agent is free and the save must not
+        be silently dropped."""
         self._notify_agent_to_create_saver()
         # every rank locks its shard: the agent's breakpoint save reads
         # all local shards, so an unlocked write can be torn even for
@@ -174,7 +180,9 @@ class CheckpointEngine:
         # is no concurrent reader and no lock server to talk to
         locked = False
         if self._agent_lock_available():
-            if not self._shm_lock.acquire(blocking=False):
+            if not self._shm_lock.acquire(
+                blocking=block_lock, timeout=600.0
+            ):
                 logger.info(
                     "step %s: saver busy persisting; skipping shm save",
                     step,
@@ -262,7 +270,9 @@ class CheckpointEngine:
                 return
             step, snap, path, enqueue = item
             try:
-                ok = self.save_to_memory(step, snap, path)
+                ok = self.save_to_memory(
+                    step, snap, path, block_lock=True
+                )
                 if ok and enqueue and self._event_queue is not None:
                     self._event_queue.put(
                         CheckpointEvent(

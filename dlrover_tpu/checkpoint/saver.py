@@ -31,7 +31,6 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
 from dlrover_tpu.common.storage import (
     CheckpointStorage,
-    PosixDiskStorage,
     get_checkpoint_storage,
 )
 
@@ -90,7 +89,9 @@ class AsyncCheckpointSaver:
     def __init__(self, config: SaverConfig,
                  storage: Optional[CheckpointStorage] = None):
         self.config = config
-        self.storage = storage or get_checkpoint_storage()
+        self.storage = storage or get_checkpoint_storage(
+            path=config.checkpoint_dir
+        )
         self._shm_handlers = [
             SharedMemoryHandler(r, host=True)
             for r in range(config.local_shard_num)
@@ -384,7 +385,7 @@ def read_last_checkpoint(
     (reference: the load fallback in engine.py:325 when shm misses).
     Returns (step, {global_rank: (meta, raw_bytes)}) or (None, {}).
     """
-    storage = storage or PosixDiskStorage()
+    storage = storage or get_checkpoint_storage(path=checkpoint_dir)
     tracker = os.path.join(checkpoint_dir, CheckpointConstant.TRACKER_FILE)
     if not storage.exists(tracker):
         return None, {}

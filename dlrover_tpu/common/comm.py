@@ -251,7 +251,10 @@ class MessageServer:
         logger.info("MessageServer listening on port %s", self.port)
 
     def stop(self):
-        self._server.shutdown()
+        # shutdown() blocks forever if serve_forever never ran (stop
+        # before start); only the socket close is needed then
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
 
 

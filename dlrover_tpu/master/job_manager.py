@@ -105,6 +105,8 @@ class JobManager:
             self.update_node_status(node_id, node.type, NodeStatus.RUNNING)
 
     def start_heartbeat_monitor(self):
+        if getattr(self, "_heartbeat_thread", None) is not None:
+            return  # idempotent: distributed start() + prepare() both call
         self._heartbeat_thread = threading.Thread(
             target=self._monitor_heartbeats,
             name="heartbeat-monitor",

@@ -26,10 +26,72 @@ def parse_args(argv=None):
     return parser.parse_args(argv)
 
 
-def run(args) -> int:
-    master = JobMaster(
-        port=args.port, node_num=args.node_num, job_name=args.job_name
+def _host_ip() -> str:
+    """Pod-reachable address of this host (no DNS dependence — a UDP
+    connect never sends packets but resolves the egress interface)."""
+    import socket
+
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.settimeout(1.0)
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def create_master(args) -> JobMaster:
+    """Compose the master for the target platform (reference:
+    dist_master.py:86 owning job manager + watchers + auto-scaler).
+
+    ``kubernetes``: DistributedJobManager over PodScaler/PodWatcher,
+    plus the AllreduceAutoScaler and the ScalePlan CR watcher that
+    executes externally written plans (k8s_watcher.py:267 parity).
+    """
+    if args.platform != "kubernetes":
+        return JobMaster(
+            port=args.port, node_num=args.node_num,
+            job_name=args.job_name,
+        )
+    from dlrover_tpu.master.auto_scaler import AllreduceAutoScaler
+    from dlrover_tpu.master.node_manager import DistributedJobManager
+    from dlrover_tpu.master.resource_optimizer import LocalOptimizer
+    from dlrover_tpu.master.scaler import PodScaler
+    from dlrover_tpu.master.watcher import PodWatcher, ScalePlanWatcher
+    from dlrover_tpu.scheduler.job_args import new_job_args
+    from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+    client = K8sClient.singleton()
+    job_args = new_job_args(
+        platform="kubernetes", job_name=args.job_name,
+        num_workers=args.node_num,
     )
+    scaler = PodScaler(args.job_name, client, master_addr="")
+    job_manager = DistributedJobManager(job_args, scaler)
+    job_manager._watcher = PodWatcher(
+        args.job_name, client, job_manager.process_event
+    )
+    master = JobMaster(
+        port=args.port, node_num=args.node_num,
+        job_name=args.job_name, job_manager=job_manager,
+    )
+    # worker pods reach the master at this host's bound port
+    scaler._master_addr = f"{_host_ip()}:{master.port}"
+    master.aux_services.append(
+        ScalePlanWatcher(args.job_name, client, job_manager)
+    )
+    master.aux_services.append(
+        AllreduceAutoScaler(
+            job_manager, master.speed_monitor,
+            optimizer=LocalOptimizer(), min_nodes=1,
+            max_nodes=args.node_num,
+        )
+    )
+    return master
+
+
+def run(args) -> int:
+    master = create_master(args)
     master.prepare()
     return master.run()
 

@@ -36,11 +36,15 @@ class JobMaster:
         node_num: int = 1,
         job_name: str = "local-job",
         coordinator_port: int = 0,
+        job_manager: Optional[JobManager] = None,
     ):
         self.job_name = job_name
         self.node_num = node_num
         self.speed_monitor = SpeedMonitor()
-        self.job_manager = JobManager()
+        # platform-backed masters inject a DistributedJobManager
+        # (node watching/scaling); local mode uses the plain one
+        self.job_manager = job_manager or JobManager()
+        self.aux_services = []  # started in prepare(), stopped in stop()
         self.task_manager = TaskManager()
         self.kv_store = KVStoreService()
         self.elastic_rdzv = ElasticTrainingRendezvousManager()
@@ -78,7 +82,11 @@ class JobMaster:
 
     def prepare(self):
         self.task_manager.start()
+        if hasattr(self.job_manager, "start"):
+            self.job_manager.start()  # distributed: watcher + pods
         self.job_manager.start_heartbeat_monitor()
+        for svc in self.aux_services:
+            svc.start()
         self._server.start()
         logger.info(
             "master %s serving on port %s for %d node(s)",
@@ -130,6 +138,11 @@ class JobMaster:
 
     def stop(self):
         self._stop.set()
+        for svc in self.aux_services:
+            try:
+                svc.stop()
+            except Exception:  # noqa: BLE001
+                logger.exception("stopping %s failed", svc)
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()

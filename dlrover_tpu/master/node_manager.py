@@ -162,7 +162,19 @@ class DistributedJobManager(JobManager):
         )
         self._scaler.scale(plan)
 
-    # -- scaling (used by the auto-scaler) ---------------------------------
+    # -- scaling (used by the auto-scaler / scale-plan watcher) ------------
+
+    def remove_node(self, node_id: int):
+        """Release one node without relaunch (scale-plan removePods;
+        reference: _migrate/remove handling in dist_job_manager)."""
+        node = self.get_node(node_id)
+        if node is None or node.is_released:
+            return None
+        node.relaunchable = False
+        node.is_released = True
+        self._scaler.scale(ScalePlan(remove_nodes=[node]))
+        logger.info("removed node %s per scale plan", node_id)
+        return node
 
     def adjust_worker_count(self, target: int) -> ScalePlan:
         """Grow/shrink the worker group to ``target`` (reference:

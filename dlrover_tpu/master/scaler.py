@@ -152,7 +152,13 @@ class ElasticJobScaler(Scaler):
             "kind": "ScalePlan",
             "metadata": {
                 "name": f"{self._job_name}-scaleplan-{self._plan_index}",
-                "labels": {"elasticjob-name": self._job_name},
+                # origin=master: this plan is pod-level instructions
+                # FOR the operator; the master's own ScalePlanWatcher
+                # must not loop it back into the job manager
+                "labels": {
+                    "elasticjob-name": self._job_name,
+                    "origin": "master",
+                },
             },
             "spec": {
                 "ownerJob": self._job_name,

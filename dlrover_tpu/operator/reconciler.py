@@ -111,3 +111,107 @@ class ElasticJobReconciler:
             except Exception:  # noqa: BLE001
                 logger.exception("reconcile failed")
             time.sleep(interval)
+
+
+def build_worker_pod(job_name: str, item: Dict) -> Dict:
+    """Worker pod body from a ScalePlan createPods entry (reference:
+    pod factory in scaleplan_controller.go)."""
+    node_id = int(item.get("id", 0))
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": item.get(
+                "name", f"{job_name}-worker-{node_id}"
+            ),
+            "labels": {
+                "app": "dlrover-tpu",
+                "job": job_name,
+                "elasticjob-name": job_name,
+                "node-type": item.get("type", "worker"),
+                "node-id": str(node_id),
+                "rank": str(item.get("rankIndex", node_id)),
+            },
+            "ownerReferences": [
+                {
+                    "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
+                    "kind": "ElasticJob",
+                    "name": job_name,
+                    "controller": True,
+                }
+            ],
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [
+                {
+                    "name": "worker",
+                    "command": ["tpurun"],
+                    "env": [
+                        {"name": NodeEnv.JOB_NAME, "value": job_name},
+                        {
+                            "name": NodeEnv.NODE_ID,
+                            "value": str(node_id),
+                        },
+                    ],
+                }
+            ],
+        },
+    }
+
+
+class ScalePlanReconciler:
+    """Operator side of the ScalePlan CRD: executes plans the master's
+    ``ElasticJobScaler`` writes — creates/removes worker pods — and
+    records the outcome in the CR status (reference:
+    ``scaleplan_controller.go``; the master-side consumer of externally
+    written plans is ``master.watcher.ScalePlanWatcher``)."""
+
+    def __init__(self, client: K8sClient):
+        self._client = client
+
+    def reconcile_once(self) -> int:
+        from dlrover_tpu.master.watcher import (
+            SCALE_PLAN_TERMINAL_PHASES,
+        )
+
+        executed = 0
+        for cr in self._client.list_scale_plan_crs():
+            status = cr.get("status", {})
+            if status.get("phase") in SCALE_PLAN_TERMINAL_PHASES:
+                continue
+            spec = cr.get("spec", {})
+            job_name = spec.get("ownerJob", "")
+            name = cr.get("metadata", {}).get("name", "unnamed")
+            created, removed = 0, 0
+            try:
+                for item in spec.get("createPods", []):
+                    if self._client.create_pod(
+                        build_worker_pod(job_name, item)
+                    ):
+                        created += 1
+                for item in spec.get("removePods", []):
+                    if self._client.delete_pod(item.get("name", "")):
+                        removed += 1
+                cr.setdefault("status", {})["phase"] = "Succeeded"
+            except Exception as e:  # noqa: BLE001
+                logger.exception("scale plan %s failed", name)
+                cr.setdefault("status", {})["phase"] = "Failed"
+                cr["status"]["message"] = str(e)
+            cr["status"]["createdPods"] = created
+            cr["status"]["removedPods"] = removed
+            self._client.patch_scale_plan_status(name, cr)
+            executed += 1
+            logger.info(
+                "scale plan %s: created %s removed %s pods",
+                name, created, removed,
+            )
+        return executed
+
+    def run(self, interval: float = 3.0, stop_event=None):
+        while stop_event is None or not stop_event.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("scale-plan reconcile failed")
+            time.sleep(interval)

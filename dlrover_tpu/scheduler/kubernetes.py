@@ -56,6 +56,11 @@ class K8sApi:
     ) -> bool:
         raise NotImplementedError
 
+    def list_custom_resources(
+        self, group: str, version: str, namespace: str, plural: str,
+    ) -> List[Dict]:
+        raise NotImplementedError
+
     def watch_pods(self, namespace: str, label_selector: str):
         """Yield (event_type, pod_dict) tuples; blocks."""
         raise NotImplementedError
@@ -95,6 +100,12 @@ class RealK8sApi(K8sApi):  # pragma: no cover - needs a cluster
             group, version, namespace, plural, name, body
         )
         return True
+
+    def list_custom_resources(self, group, version, namespace, plural):
+        out = self._custom.list_namespaced_custom_object(
+            group, version, namespace, plural
+        )
+        return list(out.get("items", []))
 
     def create_custom_resource(self, group, version, namespace, plural,
                                body):
@@ -165,6 +176,13 @@ class MockK8sApi(K8sApi):
         self.custom_resources[f"{plural}/{name}"] = body
         return True
 
+    def list_custom_resources(self, group, version, namespace, plural):
+        prefix = f"{plural}/"
+        return [
+            body for key, body in self.custom_resources.items()
+            if key.startswith(prefix)
+        ]
+
     def watch_pods(self, namespace, label_selector):
         while True:
             try:
@@ -221,3 +239,23 @@ class K8sClient:
             "elastic.dlrover-tpu.org", "v1alpha1", self.namespace,
             "scaleplans", body,
         )
+
+    def list_scale_plan_crs(self) -> List[Dict]:
+        try:
+            return self.api.list_custom_resources(
+                "elastic.dlrover-tpu.org", "v1alpha1", self.namespace,
+                "scaleplans",
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.error("list_scale_plan_crs failed: %s", e)
+            return []
+
+    def patch_scale_plan_status(self, name: str, body: Dict) -> bool:
+        try:
+            return self.api.patch_custom_resource(
+                "elastic.dlrover-tpu.org", "v1alpha1", self.namespace,
+                "scaleplans", name, body,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.error("patch_scale_plan_status failed: %s", e)
+            return False

@@ -132,3 +132,66 @@ def test_plan_rebuilds_model_config():
         strategy=Strategy(opts=[("checkpoint", {})]),
     )
     assert result.model.config.remat is True
+
+
+def test_mesh_factorizations_cover_device_count():
+    from dlrover_tpu.accel.strategy_search import mesh_factorizations
+
+    triples = mesh_factorizations(8)
+    assert all(d * f * t == 8 for d, f, t in triples)
+    assert (8, 1, 1) in triples and (1, 8, 1) in triples
+    assert (2, 2, 2) in triples
+
+
+def test_search_prefers_sharded_when_model_does_not_fit(monkeypatch):
+    """A model too big to replicate must make the search pick an
+    fsdp/tp factorization over pure DP (VERDICT #6 done-criterion)."""
+    import dlrover_tpu.accel.analyser as analyser_mod
+    from dlrover_tpu.accel.strategy_search import (
+        generate_candidates,
+        search_strategy,
+    )
+
+    model, loss_fn, batch = _context()
+    context = ModelContext(
+        model=model, optim_factory=lambda: optax.sgd(1e-2),
+        loss_fn=loss_fn, sample_batch=batch,
+    )
+    # shrink the "chip" so the replicated state does not fit but a
+    # >=4-way shard does
+    real = analyser_mod.analyse
+
+    def tight_analyse(ctx):
+        a = real(ctx)
+        a.per_device_hbm = int(a.model_state_bytes() / 2)
+        a.batch_bytes = 0
+        return a
+
+    monkeypatch.setattr(analyser_mod, "analyse", tight_analyse)
+    monkeypatch.setattr(
+        "dlrover_tpu.accel.strategy_search.analyse", tight_analyse
+    )
+    cands = generate_candidates(context, 8)
+    assert all(c.fsdp * c.tensor >= 4 for c in cands), [
+        c.describe() for c in cands
+    ]
+    result = search_strategy(
+        context, 8, dry_run_budget=3, grad_accums=(1,)
+    )
+    assert result.best.fsdp > 1 or result.best.tensor > 1
+    assert result.best.step_time_s is not None
+
+
+def test_search_bo_respects_budget():
+    from dlrover_tpu.accel.strategy_search import search_strategy
+
+    model, loss_fn, batch = _context()
+    context = ModelContext(
+        model=model, optim_factory=lambda: optax.sgd(1e-2),
+        loss_fn=loss_fn, sample_batch=batch,
+    )
+    result = search_strategy(
+        context, 8, dry_run_budget=4, grad_accums=(1, 2)
+    )
+    assert len(result.evaluated) <= 4
+    assert result.best.step_time_s is not None

@@ -215,9 +215,9 @@ def test_async_snapshot_skips_when_busy(saver, tmp_path):
     gate = threading.Event()
     orig = engine.save_to_memory
 
-    def gated(step, state, path=""):
+    def gated(step, state, path="", **kw):
         gate.wait(timeout=30.0)
-        return orig(step, state, path)
+        return orig(step, state, path, **kw)
 
     engine.save_to_memory = gated
     assert engine.save_to_storage(2, sd)  # writer now blocked on gate

@@ -311,3 +311,29 @@ def test_servicer_end_to_end(local_master):
     assert resp.success  # hardware -> relaunch
     c0.close()
     c1.close()
+
+
+def test_create_master_kubernetes_composition():
+    """platform=kubernetes composes DistributedJobManager + scale-plan
+    watcher + auto-scaler (reference: dist_master.py:86)."""
+    from dlrover_tpu.master.auto_scaler import AllreduceAutoScaler
+    from dlrover_tpu.master.main import create_master, parse_args
+    from dlrover_tpu.master.node_manager import DistributedJobManager
+    from dlrover_tpu.master.watcher import ScalePlanWatcher
+    from dlrover_tpu.scheduler.kubernetes import K8sClient, MockK8sApi
+
+    K8sClient.reset()
+    K8sClient.singleton(namespace="test", api=MockK8sApi())
+    try:
+        args = parse_args([
+            "--platform", "kubernetes", "--job_name", "kj",
+            "--node_num", "2", "--port", "0",
+        ])
+        master = create_master(args)
+        assert isinstance(master.job_manager, DistributedJobManager)
+        kinds = [type(s) for s in master.aux_services]
+        assert ScalePlanWatcher in kinds
+        assert AllreduceAutoScaler in kinds
+        master.stop()
+    finally:
+        K8sClient.reset()

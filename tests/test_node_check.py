@@ -1,0 +1,97 @@
+"""Fabric-probe node check: real psum/ppermute collective timings on
+the 8-device CPU mesh, and multi-process straggler isolation — an
+injected-slow rank is caught by the master's >2x-median rule from the
+probe timings alone (reference chaos flow:
+docs/tech_report/fault_tolerance_exps.md + rdzv_manager.py:550)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.agent.node_check import (
+    bm_chip_matmul,
+    bm_collective_probe,
+)
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.master.master import JobMaster
+
+
+def test_collective_probe_runs_on_mesh():
+    elapsed = bm_collective_probe(payload_floats=1 << 16, rounds=2)
+    assert elapsed is not None and elapsed > 0
+
+
+def test_collective_probe_none_on_single_device(monkeypatch):
+    import jax
+
+    one = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda: one)
+    assert bm_collective_probe() is None
+
+
+CHILD = r"""
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.node_check import run_node_check
+from dlrover_tpu.common.constants import RendezvousName
+
+rank = int(os.environ["DLROVER_NODE_RANK"])
+client = MasterClient(sys.argv[1], node_id=rank, node_type="worker")
+client.join_rendezvous(rank, 1, RendezvousName.NETWORK_CHECK)
+# wait for the full world so every node's timer starts together
+deadline = time.time() + 60
+while time.time() < deadline:
+    _, _, world, _ = client.get_comm_world(
+        RendezvousName.NETWORK_CHECK, rank
+    )
+    if len(world) >= 3:
+        break
+    time.sleep(0.2)
+normal, elapsed = True, 0.0
+try:
+    elapsed = run_node_check(
+        client=client, world_size=3, round_id=0, matmul_size=128,
+    )
+except Exception as e:
+    print("check failed:", e, flush=True)
+    normal = False
+client.report_network_status(rank, normal, elapsed)
+print(f"rank {rank} elapsed {elapsed:.2f}", flush=True)
+"""
+
+
+def test_injected_straggler_isolated_via_probe_timings(tmp_path):
+    master = JobMaster(port=0, node_num=3, job_name="ncheck")
+    master.network_rdzv.update_rdzv_params(min_nodes=3, max_nodes=3)
+    master.prepare()
+    try:
+        addr = f"127.0.0.1:{master.port}"
+        procs = []
+        for rank in range(3):
+            env = dict(
+                os.environ,
+                DLROVER_NODE_RANK=str(rank),
+                JAX_PLATFORMS="cpu",
+                PYTHONPATH="/root/repo",
+                MOCK_STRAGGLER_RANK="1",
+                MOCK_STRAGGLER_DELAY="6.0",
+                DLROVER_SHARED_DIR=str(tmp_path / "sockets"),
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", CHILD, addr],
+                env=env, cwd="/root/repo",
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            ))
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            assert p.returncode == 0, out
+        stragglers, median = master.network_rdzv.detect_stragglers()
+        assert stragglers == [1], (stragglers, median)
+    finally:
+        master.stop()

@@ -202,3 +202,109 @@ def test_auto_scaler_probes_up(k8s):
         assert _wait_until(lambda: len(api.pods) == 3)
     finally:
         mgr.stop()
+
+
+def test_scaleplan_operator_roundtrip(k8s):
+    """ElasticJobScaler writes a ScalePlan CR -> the operator-side
+    ScalePlanReconciler executes it into pod creates/removes and marks
+    the CR Succeeded; re-reconciling is a no-op (reference:
+    scaleplan_controller.go)."""
+    from dlrover_tpu.common.node import new_worker
+    from dlrover_tpu.operator.reconciler import ScalePlanReconciler
+
+    client, api = k8s
+    scaler = ElasticJobScaler("tj", client)
+    scaler.scale(ScalePlan(
+        launch_nodes=[new_worker(0, rank=0), new_worker(1, rank=1)]
+    ))
+    rec = ScalePlanReconciler(client)
+    assert rec.reconcile_once() == 1
+    assert len(api.pods) == 2
+    pod = api.pods["tj-worker-0"]
+    assert pod["metadata"]["labels"]["node-id"] == "0"
+    assert pod["metadata"]["ownerReferences"][0]["name"] == "tj"
+    # idempotent: executed plans are skipped
+    assert rec.reconcile_once() == 0
+    assert api.create_calls == 2
+
+    # removal plan round trip
+    scaler.scale(ScalePlan(remove_nodes=[new_worker(1, rank=1)]))
+    assert rec.reconcile_once() == 1
+    assert "tj-worker-1" not in api.pods
+
+
+def test_scaleplan_watcher_resizes_world(k8s):
+    """An externally written ScalePlan CR (user/Brain) is picked up by
+    the master's ScalePlanWatcher and executed through the job manager
+    at node_unit granularity (reference: k8s_watcher.py:267)."""
+    from dlrover_tpu.master.watcher import ScalePlanWatcher
+
+    client, api = k8s
+    mgr = _manager(client, num_workers=2)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        for name in list(api.pods):
+            api.set_pod_phase(name, "Running")
+        _wait_until(lambda: sum(
+            1 for n in mgr.all_nodes().values()
+            if n.status == NodeStatus.RUNNING
+        ) == 2)
+        client.apply_scale_plan_cr("manual-1", {
+            "metadata": {"name": "manual-1"},
+            "spec": {
+                "ownerJob": "tj",
+                "replicaResourceSpecs": {
+                    "worker": {"replicas": 5}
+                },
+            },
+        })
+        watcher = ScalePlanWatcher("tj", client, mgr, node_unit=2)
+        assert watcher.reconcile_once() == 1
+        # 5 rounded down to node_unit 2 -> 4 workers
+        assert _wait_until(lambda: len(api.pods) == 4)
+        cr = api.custom_resources["scaleplans/manual-1"]
+        assert cr["status"]["phase"] == "Executed"
+        assert cr["status"]["workerTarget"] == 4
+        # executed plans are not re-run
+        assert watcher.reconcile_once() == 0
+
+        # a plan removing one pod by name
+        client.apply_scale_plan_cr("manual-2", {
+            "metadata": {"name": "manual-2"},
+            "spec": {
+                "ownerJob": "tj",
+                "removePods": [{"name": "tj-worker-0"}],
+            },
+        })
+        assert watcher.reconcile_once() == 1
+        node0 = mgr.get_node(0)
+        assert node0.is_released and not node0.relaunchable
+    finally:
+        mgr.stop()
+
+
+def test_scaleplan_watcher_skips_master_origin_plans(k8s):
+    """Plans the master wrote for the operator (origin=master) must
+    not be looped back into the job manager, and both consumers share
+    the terminal-phase vocabulary (no ping-pong)."""
+    from dlrover_tpu.common.node import new_worker
+    from dlrover_tpu.master.watcher import ScalePlanWatcher
+    from dlrover_tpu.operator.reconciler import ScalePlanReconciler
+
+    client, api = k8s
+    scaler = ElasticJobScaler("tj", client)
+    scaler.scale(ScalePlan(launch_nodes=[new_worker(0, rank=0)]))
+
+    class Boom:
+        def all_nodes(self):
+            raise AssertionError("watcher must not execute this plan")
+
+        adjust_worker_count = all_nodes
+
+    watcher = ScalePlanWatcher("tj", client, Boom())
+    assert watcher.reconcile_once() == 0
+    rec = ScalePlanReconciler(client)
+    assert rec.reconcile_once() == 1     # operator executes it
+    assert rec.reconcile_once() == 0     # terminal for the operator
+    assert watcher.reconcile_once() == 0  # still terminal for master
