@@ -86,6 +86,8 @@ def _apply_plan_to_model(plan: AccelPlan, context: ModelContext):
     if hasattr(cfg, "dtype") and plan.compute_dtype in dtype_map:
         if cfg.dtype != dtype_map[plan.compute_dtype]:
             updates["dtype"] = dtype_map[plan.compute_dtype]
+    if plan.fp8 and hasattr(cfg, "fp8") and not cfg.fp8:
+        updates["fp8"] = True
     if not updates:
         return model
     new_cfg = dataclasses.replace(cfg, **updates)

@@ -172,6 +172,19 @@ class HalfOpt(AmpNativeOpt):
     name = "half"
 
 
+class Fp8Opt(Optimization):
+    """FP8 (e4m3, dynamic scaling) matmuls where the model supports it
+    (reference: Fp8Optimization + TransformerEngine patching; here
+    :mod:`dlrover_tpu.ops.fp8` — no external library)."""
+
+    name = "fp8"
+
+    def apply(self, plan, config, context=None):
+        plan.fp8 = True
+        plan.notes.append("fp8 (e4m3) matmuls")
+        return plan
+
+
 class CheckpointOpt(Optimization):
     """Activation rematerialization (reference:
     checkpoint_optimization.py -> jax.checkpoint per block)."""
@@ -228,8 +241,8 @@ class OptimizationLibrary:
         for cls in (
             ParallelModeOpt, Zero1Opt, Zero2Opt, FSDPOpt,
             TensorParallelOpt, SequenceParallelOpt, ExpertParallelOpt,
-            MixedParallelOpt, AmpNativeOpt, HalfOpt, CheckpointOpt,
-            ModuleReplaceOpt, PipelineParallelOpt,
+            MixedParallelOpt, AmpNativeOpt, HalfOpt, Fp8Opt,
+            CheckpointOpt, ModuleReplaceOpt, PipelineParallelOpt,
         ):
             self.register(cls())
 

@@ -30,6 +30,7 @@ class AccelPlan:
     sequence_parallel: str = "none"  # none | ulysses | ring
     grad_accum: int = 1
     pipeline_microbatches: int = 4
+    fp8: bool = False
     notes: List[str] = field(default_factory=list)
 
     def effective_opt_rules(self) -> PartitionRules:

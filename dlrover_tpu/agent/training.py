@@ -80,10 +80,14 @@ class RendezvousOutcome:
         return sum(self.world.values())
 
     def base_rank(self, node_rank: int) -> int:
-        return sum(
-            size for rank, size in sorted(self.world.items())
-            if rank < node_rank
-        )
+        """The world dict's iteration order IS the global rank order
+        (the master emits it topology-sorted; pickle preserves it)."""
+        base = 0
+        for rank, size in self.world.items():
+            if rank == node_rank:
+                return base
+            base += size
+        return base
 
 
 class MasterRendezvousHandler:
@@ -265,10 +269,12 @@ class ElasticTrainingAgent:
         except Exception as e:  # noqa: BLE001
             logger.warning("num_nodes_waiting failed: %s", e)
             return False
-        if waiting == 0:
+        if waiting <= 0:
             return False
-        # node_unit rounding: only restart when a full unit can join.
-        return waiting % self._spec.node_unit == 0 or waiting < 0
+        # node_unit rounding: only restart when at least one full unit
+        # of nodes can join (reference: _membership_changed,
+        # training.py:711 restarts at node-unit granularity)
+        return waiting >= self._spec.node_unit
 
     def _save_ckpt_at_breakpoint(self):
         if self._save_ckpt_hook is not None:

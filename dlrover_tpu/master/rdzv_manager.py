@@ -53,6 +53,8 @@ class RendezvousManager:
     """Shared mechanics of both rendezvous flavours."""
 
     def __init__(self, name: str = ""):
+        from dlrover_tpu.master.net_topology import DpTopologySorter
+
         self._name = name
         self._lock = threading.Lock()
         self._params = RendezvousParameters()
@@ -63,6 +65,20 @@ class RendezvousManager:
         self._rdzv_round = 0
         self._start_waiting_time = 0.0
         self._coordinator_port = 0
+        self._topology_sorter = DpTopologySorter()
+
+    def set_topology_querier(self, querier):
+        """Plug a fabric-coordinate source; the completed world is
+        ordered by it so rank-adjacent nodes share a slice (reference:
+        topology-sorted rendezvous, net_topology.py:62)."""
+        from dlrover_tpu.master.net_topology import DpTopologySorter
+
+        with self._lock:
+            self._topology_sorter = DpTopologySorter(querier=querier)
+            if self._rdzv_nodes:
+                self._rank_order = self._topology_sorter.sort(
+                    self._rdzv_nodes
+                )
 
     # -- configuration ----------------------------------------------------
 
@@ -146,6 +162,9 @@ class RendezvousManager:
         ranks = sorted(self._waiting_nodes.keys())[:accept]
         self._rdzv_nodes = {r: self._waiting_nodes.pop(r) for r in ranks}
         self._latest_rdzv_nodes = ranks
+        # topology order computed once per completed round; every
+        # get_comm_world poll reuses it
+        self._rank_order = self._topology_sorter.sort(self._rdzv_nodes)
         self._rdzv_round += 1
         self._start_waiting_time = 0.0
         logger.info(
@@ -163,15 +182,26 @@ class RendezvousManager:
             return len(self._waiting_nodes)
 
     def _world(self) -> Dict[int, int]:
+        """Iteration ORDER of the returned dict is the global rank
+        order (preserved through pickle); the topology sorter places
+        slice-mates adjacently so DP collectives ride ICI."""
+        order = getattr(self, "_rank_order", None) or sorted(
+            self._rdzv_nodes
+        )
         return {
-            rank: meta.local_world_size
-            for rank, meta in sorted(self._rdzv_nodes.items())
+            rank: self._rdzv_nodes[rank].local_world_size
+            for rank in order
         }
 
     def _coordinator(self) -> str:
+        """jax.distributed coordinator = the node holding global rank
+        0, i.e. the first node in topology order."""
         if not self._rdzv_nodes:
             return ""
-        first = self._rdzv_nodes[min(self._rdzv_nodes)]
+        order = getattr(self, "_rank_order", None) or sorted(
+            self._rdzv_nodes
+        )
+        first = self._rdzv_nodes[order[0]]
         host = first.node_ip or "127.0.0.1"
         return f"{host}:{self._coordinator_port or 52525}"
 

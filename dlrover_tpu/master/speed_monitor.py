@@ -24,14 +24,37 @@ class SpeedMonitor:
         self._batch_size = 0
         self._worker_adjustment_time = 0.0
         self._running_workers: Set[int] = set()
+        # goodput/MFU accounting (the north-star metric: BASELINE.md
+        # targets >=95% goodput under churn; reference README:55-57)
+        self._flops_per_sample = 0.0
+        self._peak_flops = 0.0
+        self._productive_seconds = 0.0
+        self._last_productive_mark = 0.0
 
     def set_batch_size(self, batch_size: int):
         self._batch_size = batch_size
+
+    def set_model_flops(
+        self, flops_per_sample: float, peak_flops: float
+    ):
+        """Enable MFU: per-sample model FLOPs (~6N x seq for a decoder
+        LM) and the cluster's aggregate peak FLOP/s."""
+        with self._lock:
+            self._flops_per_sample = flops_per_sample
+            self._peak_flops = peak_flops
 
     def collect_global_step(self, step: int, timestamp: float = 0.0):
         ts = timestamp or time.time()
         with self._lock:
             if step > self._global_step:
+                # productive time: gaps between consecutive step
+                # reports; long silences (restarts, rendezvous) are
+                # capped so they count as lost time in goodput
+                if self._last_productive_mark:
+                    gap = ts - self._last_productive_mark
+                    if 0 < gap < 300.0:
+                        self._productive_seconds += gap
+                self._last_productive_mark = ts
                 self._global_step = step
                 self._last_step_time = ts
                 self._samples.append((ts, step))
@@ -58,6 +81,26 @@ class SpeedMonitor:
 
     def samples_per_second(self) -> float:
         return self.running_speed() * self._batch_size
+
+    def mfu(self) -> float:
+        """Model FLOPs utilization over the sample window (0 when
+        ``set_model_flops`` was never called)."""
+        if not self._peak_flops or not self._flops_per_sample:
+            return 0.0
+        return (
+            self.samples_per_second() * self._flops_per_sample
+            / self._peak_flops
+        )
+
+    def goodput(self) -> float:
+        """Fraction of wall-clock spent making step progress — the
+        north-star metric under churn (reference claim: 69% -> 95%
+        with fault tolerance + flash ckpt, README.md:55-57)."""
+        with self._lock:
+            wall = time.time() - self._start_time
+            if wall <= 0:
+                return 0.0
+            return min(1.0, self._productive_seconds / wall)
 
     # -- membership-change windows ----------------------------------------
 

@@ -158,6 +158,59 @@ class BatchDatasetManager:
             self._task_id += 1
 
 
+class StreamingDatasetManager(BatchDatasetManager):
+    """Unbounded-stream dispatch (reference
+    ``streaming_dataset_manager.py:204``): shards are emitted from
+    growing partition offsets, the todo queue refills while earlier
+    shards are still in flight, and the checkpoint carries the
+    partition offsets so a restore resumes the stream exactly where
+    acked consumption stopped (un-acked shards are re-queued)."""
+
+    def _fill_todo(self):
+        # streams keep flowing: refill whenever the todo queue drains,
+        # without waiting for in-flight shards to complete
+        if self.todo:
+            return
+        if self.splitter.epoch_finished():
+            return
+        self.splitter.create_shards()
+        for shard in self.splitter.get_shards():
+            self.todo.append(
+                ShardTask(
+                    task_id=self._task_id,
+                    task_type=self.task_type,
+                    dataset_name=self.splitter.dataset_name,
+                    start=shard.start,
+                    end=shard.end,
+                    indices=shard.indices,
+                )
+            )
+            self._task_id += 1
+
+    def completed(self) -> bool:
+        # unbounded unless the splitter was capped
+        return (
+            self.splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+    def checkpoint(self) -> Dict:
+        state = super().checkpoint()
+        state["partition_offsets"] = dict(
+            self.splitter.partition_offsets.offsets
+        )
+        state["emitted"] = self.splitter._emitted
+        return state
+
+    def restore(self, state: Dict):
+        super().restore(state)
+        offsets = state.get("partition_offsets")
+        if offsets is not None:
+            self.splitter.partition_offsets.offsets = dict(offsets)
+        self.splitter._emitted = state.get("emitted", 0)
+
+
 class TaskManager:
     """Owns every dataset's manager (reference ``TaskManager:37``)."""
 
@@ -184,10 +237,18 @@ class TaskManager:
                 dataset_name=params.dataset_name,
                 num_minibatches_per_shard=params.num_minibatches_per_shard,
             )
-            self._datasets[params.dataset_name] = BatchDatasetManager(
+            manager_cls = (
+                StreamingDatasetManager
+                if params.storage_type == "stream"
+                else BatchDatasetManager
+            )
+            self._datasets[params.dataset_name] = manager_cls(
                 params.task_type or TaskType.TRAINING, splitter
             )
-            logger.info("new dataset %s registered", params.dataset_name)
+            logger.info(
+                "new dataset %s registered (%s)",
+                params.dataset_name, manager_cls.__name__,
+            )
 
     def get_dataset_task(
         self, worker_id: int, dataset_name: str

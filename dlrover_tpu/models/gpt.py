@@ -43,6 +43,15 @@ class GPTConfig:
     # "xla" = dot-product attention lowered by XLA; "flash" = Pallas
     attention_impl: str = "xla"
     tie_embeddings: bool = True
+    # autoregressive decoding: attention keeps a KV cache ("cache"
+    # collection) and consumes arbitrary-length chunks (prompt
+    # prefill or one-token decode steps)
+    decode: bool = False
+    # "lm" -> vocab logits; "value" -> per-token scalar (RLHF critic)
+    head: str = "lm"
+    # fp8 (e4m3, dynamic scaling) matmuls in the MLP — the FLOPs bulk
+    # (reference capability: Fp8Optimization / TransformerEngine)
+    fp8: bool = False
     # MoE: 0 = dense; >0 replaces the MLP of every ``moe_every``-th
     # block with an expert-parallel MoEMLP (reference: moe_layer.py)
     moe_experts: int = 0
@@ -133,6 +142,29 @@ def get_attention_fn(impl: str) -> AttentionFn:
     return xla_causal_attention
 
 
+def cached_decode_attention(
+    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+    q_pos: jax.Array, dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Chunked decode attention against a KV cache.
+
+    ``q``: [b, s_new, h, d] (prompt prefill or a 1-token step);
+    ``k_cache``/``v_cache``: [b, max_len, h, d] with this chunk
+    already written; ``q_pos``: [s_new] absolute positions.  Masks
+    both causality inside the chunk and the unfilled cache tail.
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    k_pos = jnp.arange(k_cache.shape[1])
+    mask = k_pos[None, :] <= q_pos[:, None]  # [s_new, max_len]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
 class Attention(nn.Module):
     config: GPTConfig
 
@@ -149,8 +181,37 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        attn_fn = get_attention_fn(cfg.attention_impl)
-        out = attn_fn(q, k, v, dtype=cfg.dtype)
+        if cfg.decode:
+            cache_shape = (
+                b, cfg.max_seq_len, cfg.num_heads, cfg.head_dim
+            )
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros(cache_shape, k.dtype),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros(cache_shape, v.dtype),
+            )
+            idx = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            pos = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, pos, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, pos, 0, 0)
+            )
+            idx.value = pos + s
+            out = cached_decode_attention(
+                q, ck.value, cv.value, pos + jnp.arange(s),
+                dtype=cfg.dtype,
+            )
+        else:
+            attn_fn = get_attention_fn(cfg.attention_impl)
+            out = attn_fn(q, k, v, dtype=cfg.dtype)
         out = out.reshape(b, s, d)
         return nn.Dense(
             d, use_bias=True, dtype=cfg.dtype,
@@ -164,12 +225,18 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
-        h = nn.Dense(
+        if cfg.fp8:
+            from dlrover_tpu.ops.fp8 import Fp8Dense
+
+            dense = Fp8Dense
+        else:
+            dense = nn.Dense
+        h = dense(
             cfg.mlp_ratio * cfg.hidden_dim, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="fc_in",
         )(x)
         h = nn.gelu(h)
-        return nn.Dense(
+        return dense(
             cfg.hidden_dim, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="fc_out",
         )(h)
@@ -220,7 +287,17 @@ class GPT(nn.Module):
             cfg.max_seq_len, cfg.hidden_dim, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="wpe",
         )
-        x = wte(tokens) + wpe(jnp.arange(s)[None])
+        if cfg.decode:
+            # absolute positions continue across decode chunks
+            pos_var = self.variable(
+                "cache", "pos_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            offset = pos_var.value
+            pos_var.value = offset + s
+        else:
+            offset = 0
+        x = wte(tokens) + wpe(offset + jnp.arange(s)[None])
         block = Block
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
@@ -230,6 +307,13 @@ class GPT(nn.Module):
             )
             x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if cfg.head == "value":
+            # scalar value head (RLHF critic / reward models)
+            v = nn.Dense(
+                1, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                name="value_head",
+            )(x.astype(cfg.dtype))
+            return v[..., 0]
         if cfg.tie_embeddings:
             logits = wte.attend(x.astype(cfg.dtype))
         else:

@@ -28,7 +28,26 @@ def master_pod_name(job_name: str) -> str:
     return f"elasticjob-{job_name}-master"
 
 
-def build_master_pod(job_name: str, spec: Dict) -> Dict:
+def owner_reference(job_name: str, uid: str) -> list:
+    """A valid ownerReference needs the owning CR's uid (the API
+    server rejects it otherwise) — emit none when the uid is unknown
+    (mock / plan-driven paths); the reconciler's explicit GC covers
+    cleanup there."""
+    if not uid:
+        return []
+    return [
+        {
+            "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
+            "kind": "ElasticJob",
+            "name": job_name,
+            "uid": uid,
+            "controller": True,
+            "blockOwnerDeletion": True,
+        }
+    ]
+
+
+def build_master_pod(job_name: str, spec: Dict, uid: str = "") -> Dict:
     """Reference: master pod factory, pkg/controllers/master/master.go."""
     worker_spec = spec.get("replicaSpecs", {}).get("worker", {})
     return {
@@ -42,6 +61,7 @@ def build_master_pod(job_name: str, spec: Dict) -> Dict:
                 "role": "master",
                 "node-id": "-1",
             },
+            "ownerReferences": owner_reference(job_name, uid),
         },
         "spec": {
             "restartPolicy": "Never",
@@ -80,11 +100,26 @@ class ElasticJobReconciler:
             p["metadata"]["name"]: p
             for p in self._client.list_pods("app=dlrover-tpu")
         }
+        # GC: pods owned by jobs whose CR is gone (a real cluster does
+        # this via ownerReferences cascade; the mock needs it explicit)
+        for pod_name, pod in list(existing.items()):
+            labels = pod.get("metadata", {}).get("labels", {})
+            owner = labels.get("job", "")
+            if owner and owner not in jobs:
+                logger.info(
+                    "garbage-collecting pod %s of deleted job %s",
+                    pod_name, owner,
+                )
+                self._client.delete_pod(pod_name)
+                existing.pop(pod_name, None)
         for name, cr in jobs.items():
             pod_name = master_pod_name(name)
             pod = existing.get(pod_name)
             if pod is None:
-                body = build_master_pod(name, cr.get("spec", {}))
+                body = build_master_pod(
+                    name, cr.get("spec", {}),
+                    uid=cr.get("metadata", {}).get("uid", ""),
+                )
                 self._client.create_pod(body)
                 phases[name] = JobPhase.PENDING
                 logger.info(
@@ -132,14 +167,9 @@ def build_worker_pod(job_name: str, item: Dict) -> Dict:
                 "node-id": str(node_id),
                 "rank": str(item.get("rankIndex", node_id)),
             },
-            "ownerReferences": [
-                {
-                    "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
-                    "kind": "ElasticJob",
-                    "name": job_name,
-                    "controller": True,
-                }
-            ],
+            "ownerReferences": owner_reference(
+                job_name, item.get("ownerUid", "")
+            ),
         },
         "spec": {
             "restartPolicy": "Never",

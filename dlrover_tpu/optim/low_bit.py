@@ -51,7 +51,18 @@ def q_adamw(
     eps: float = 1e-8,
     weight_decay: float = 0.01,
     block_size: int = DEFAULT_BLOCK,
+    bits: int = 8,
 ) -> optax.GradientTransformation:
+    """AdamW with int8 (fused Pallas step) or int4 (packed nibbles,
+    8x less moment HBM; reference: 4-bit family in
+    atorch/optimizers/low_bit/) moment storage."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if bits == 4:
+        return _q_adamw_4bit(
+            learning_rate, b1, b2, eps, weight_decay, block_size
+        )
+
     def init_fn(params):
         zeros_q = jax.tree.map(
             lambda p: _quant(jnp.zeros_like(p, jnp.float32),
@@ -110,5 +121,90 @@ def q_adamw(
         mu = treedef.unflatten([o[1] for o in out])
         nu = treedef.unflatten([o[2] for o in out])
         return updates, QAdamWState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _q_adamw_4bit(
+    learning_rate, b1, b2, eps, weight_decay, block_size
+) -> optax.GradientTransformation:
+    """4-bit variant: dequant -> fp32 Adam math -> requant with the
+    packed-nibble kernels (XLA fuses the elementwise chain; the
+    second moment's wide dynamic range tolerates 4 bits because
+    scales are per small block)."""
+    from dlrover_tpu.ops.quantization import (
+        dequantize_blockwise_4bit,
+        dequantize_blockwise_4bit_sqrt,
+        quantize_blockwise_4bit,
+        quantize_blockwise_4bit_sqrt,
+    )
+
+    # nibble maps (reference: low-bit family's quantization maps):
+    # mu signed linear (its magnitudes matter uniformly), nu
+    # unsigned sqrt-domain (the optimizer reads sqrt(nu), so that is
+    # where resolution goes)
+    def q4(x):
+        packed, scales, _ = quantize_blockwise_4bit(x, block_size)
+        return QMoment(values=packed, scales=scales)
+
+    def dq4(qm, shape):
+        return dequantize_blockwise_4bit(qm.values, qm.scales, shape)
+
+    def q4u(x):
+        packed, scales, _ = quantize_blockwise_4bit_sqrt(
+            x, block_size
+        )
+        return QMoment(values=packed, scales=scales)
+
+    def dq4u(qm, shape):
+        return dequantize_blockwise_4bit_sqrt(
+            qm.values, qm.scales, shape
+        )
+
+    def init_fn(params):
+        zeros = jax.tree.map(
+            lambda p: q4(jnp.zeros_like(p, jnp.float32)), params
+        )
+        return QAdamWState(
+            count=jnp.zeros((), jnp.int32),
+            mu=zeros,
+            nu=jax.tree.map(
+                lambda p: q4u(jnp.zeros_like(p, jnp.float32)), params
+            ),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("q_adamw requires params")
+        count = state.count + 1
+        bc1 = 1 - b1**count.astype(jnp.float32)
+        bc2 = 1 - b2**count.astype(jnp.float32)
+
+        def leaf_update(g, qmu, qnu, p):
+            g = g.astype(jnp.float32)
+            mu = b1 * dq4(qmu, g.shape) + (1 - b1) * g
+            nu = b2 * dq4u(qnu, g.shape) + (1 - b2) * g * g
+            upd = -learning_rate * (
+                (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+                + weight_decay * p.astype(jnp.float32)
+            )
+            return upd.astype(p.dtype), q4(mu), q4u(nu)
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        flat_p = treedef.flatten_up_to(params)
+        out = [
+            leaf_update(g, m, n, p)
+            for g, m, n, p in zip(flat_g, flat_mu, flat_nu, flat_p)
+        ]
+        return (
+            treedef.unflatten([o[0] for o in out]),
+            QAdamWState(
+                count=count,
+                mu=treedef.unflatten([o[1] for o in out]),
+                nu=treedef.unflatten([o[2] for o in out]),
+            ),
+        )
 
     return optax.GradientTransformation(init_fn, update_fn)

@@ -149,10 +149,18 @@ def ring_attention(
     sp = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if sp == 1:
-        from dlrover_tpu.models.gpt import xla_causal_attention
-
+        # no ring to rotate (running the ring machinery on one device
+        # would only add a no-op scan + self-permute)
         if causal:
+            from dlrover_tpu.models.gpt import xla_causal_attention
+
             return xla_causal_attention(q, k, v, dtype=q.dtype)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     b, s, h, d = q.shape
     _check_divisible("seq", s, sp)
     s_loc = s // sp

@@ -1,0 +1,98 @@
+"""Autoregressive rollout generation with a KV cache.
+
+Reference capability: the RLHF engine's actor generation
+(``atorch/rl/model_engine/model_engine.py:35`` drives HF
+``generate``-style sampling for rollouts).  The TPU version runs the
+model in decode mode (``GPTConfig.decode=True`` — attention keeps a
+"cache" collection): one prefill pass over the prompt, then a
+``lax.scan`` of single-token steps, all inside one jit.  Returns the
+sampled sequences and their per-token logprobs (the "old" policy
+logprobs PPO needs).
+"""
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def decode_variant(model):
+    """The same architecture/params with the KV-cache decode path."""
+    cfg = dataclasses.replace(model.config, decode=True)
+    return type(model)(cfg)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "max_new_tokens", "temperature")
+)
+def generate(
+    model,
+    params,
+    prompts: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int = 16,
+    temperature: float = 1.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sample continuations of ``prompts`` [b, prompt_len].
+
+    Returns (sequences [b, prompt_len + max_new_tokens],
+    logprobs [b, max_new_tokens] of the sampled tokens).
+    ``model`` must be the decode variant (``decode_variant``).
+    """
+    b, prompt_len = prompts.shape
+    max_len = model.config.max_seq_len
+    if prompt_len + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt {prompt_len} + {max_new_tokens} new tokens "
+            f"exceeds max_seq_len {max_len}: the KV cache would "
+            "silently clamp and corrupt decoding"
+        )
+
+    # prefill: one chunked pass writes the prompt into the cache
+    logits, vars_ = model.apply(
+        {"params": params}, prompts, mutable=["cache"]
+    )
+    cache = vars_["cache"]
+
+    def sample(logits_last, rng):
+        if temperature <= 0.0:
+            tok = jnp.argmax(logits_last, axis=-1)
+        else:
+            tok = jax.random.categorical(
+                rng, logits_last / temperature, axis=-1
+            )
+        logp = jax.nn.log_softmax(logits_last, axis=-1)
+        tok_logp = jnp.take_along_axis(
+            logp, tok[:, None], axis=-1
+        )[:, 0]
+        return tok.astype(prompts.dtype), tok_logp
+
+    rng, sub = jax.random.split(rng)
+    tok, tok_logp = sample(logits[:, -1], sub)
+
+    def step(carry, _):
+        cache, tok, tok_logp, rng = carry
+        logits, vars_ = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt, nxt_logp = sample(logits[:, -1], sub)
+        return (vars_["cache"], nxt, nxt_logp, rng), (tok, tok_logp)
+
+    (_, last_tok, last_logp, _), (toks, logps) = jax.lax.scan(
+        step, (cache, tok, tok_logp, rng), None,
+        length=max_new_tokens - 1,
+    )
+    # scan emits the INPUT token of each step; append the final sample
+    new_tokens = jnp.concatenate(
+        [toks.T, last_tok[:, None]], axis=1
+    )
+    new_logps = jnp.concatenate(
+        [logps.T, last_logp[:, None]], axis=1
+    )
+    sequences = jnp.concatenate([prompts, new_tokens], axis=1)
+    return sequences, new_logps

@@ -107,7 +107,12 @@ class RLModelEngine:
 
     def sync_ref_from_actor(self):
         """Refresh the frozen reference policy from the actor (the
-        periodic ref update some RLHF recipes use)."""
+        periodic ref update some RLHF recipes use).  A real device
+        copy, not aliasing: the actor's train step donates its state,
+        so held references to the live params would be invalidated on
+        the next step."""
+        import jax.numpy as jnp
+
         self._frozen_params[ModelRole.REF] = jax.tree.map(
-            lambda x: x, self._accel[ModelRole.ACTOR].state.params
+            jnp.copy, self._accel[ModelRole.ACTOR].state.params
         )

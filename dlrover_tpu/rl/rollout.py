@@ -1,0 +1,151 @@
+"""End-to-end PPO iteration over the four-role engine.
+
+Reference: the RLHF loop the ATorch engine drives
+(``atorch/rl/model_engine/model_engine.py:35`` + ppo utils): actor
+generates rollouts, reward/ref score them, critic values + GAE turn
+them into advantages, actor/critic take PPO steps.  Everything heavy
+(generation, scoring, the two train steps) is jitted; the glue here is
+plain Python per iteration.
+"""
+
+import dataclasses
+import functools
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.rl.generation import decode_variant, generate
+from dlrover_tpu.rl.model_engine import ModelRole, RLModelEngine
+from dlrover_tpu.rl.ppo import (
+    gae_advantages,
+    kl_penalty,
+    ppo_critic_loss,
+    ppo_policy_loss,
+    token_logprobs,
+)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_apply(model):
+    """One jitted forward per (hashable) flax module."""
+    return jax.jit(
+        lambda params, x: model.apply({"params": params}, x)
+    )
+
+
+def make_actor_loss(model, prompt_len: int, clip_ratio: float = 0.2):
+    """PPO-clip policy loss over the response region of the rollout
+    batch {"tokens", "old_logprobs", "advantages"}."""
+
+    def loss_fn(params, batch, model=model):
+        logits = model.apply({"params": params}, batch["tokens"][:, :-1])
+        lp = token_logprobs(logits, batch["tokens"][:, 1:])
+        lp_resp = lp[:, prompt_len - 1:]
+        return ppo_policy_loss(
+            lp_resp, batch["old_logprobs"], batch["advantages"],
+            clip_ratio=clip_ratio,
+        )
+
+    return loss_fn
+
+
+def make_critic_loss(model, prompt_len: int):
+    """Value regression over the response region of
+    {"tokens", "returns"}; ``model`` must have head="value"."""
+
+    def loss_fn(params, batch, model=model):
+        values = model.apply({"params": params}, batch["tokens"][:, :-1])
+        return ppo_critic_loss(
+            values[:, prompt_len - 1:], batch["returns"]
+        )
+
+    return loss_fn
+
+
+def sample_rollout_batch(prompts, max_new_tokens: int) -> Dict:
+    """Abstract batch matching ppo_iteration's real batches — what the
+    engine needs at build time to shape the jitted train steps."""
+    b, prompt_len = prompts.shape
+    total = prompt_len + max_new_tokens
+    return {
+        "tokens": jnp.zeros((b, total), prompts.dtype),
+        "old_logprobs": jnp.zeros((b, max_new_tokens), jnp.float32),
+        "advantages": jnp.zeros((b, max_new_tokens), jnp.float32),
+        "returns": jnp.zeros((b, max_new_tokens), jnp.float32),
+    }
+
+
+def ppo_iteration(
+    engine: RLModelEngine,
+    prompts: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int = 16,
+    temperature: float = 1.0,
+    kl_coef: float = 0.05,
+    gamma: float = 1.0,
+    lam: float = 0.95,
+    reward_fn: Callable = None,
+) -> Dict[str, float]:
+    """One full PPO iteration: rollout -> score -> GAE -> two PPO
+    steps.  ``reward_fn(sequences) -> [b]`` overrides the reward role
+    (otherwise the reward model scores the final token).
+    Returns metrics including the mean sequence reward."""
+    b, prompt_len = prompts.shape
+    actor = engine._roles[ModelRole.ACTOR].model
+    actor_decode = decode_variant(actor)
+    actor_params = engine.state(ModelRole.ACTOR).params
+
+    sequences, old_logps = generate(
+        actor_decode, actor_params, prompts, rng,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+    )
+
+    # reference logprobs over the response region (KL anchor)
+    ref_logits = engine.infer(ModelRole.REF, sequences[:, :-1])
+    ref_lp = token_logprobs(
+        ref_logits, sequences[:, 1:]
+    )[:, prompt_len - 1:]
+
+    if reward_fn is not None:
+        seq_reward = reward_fn(sequences)
+    else:
+        # reward model: per-token values, last token scores the seq
+        seq_reward = engine.infer(ModelRole.REWARD, sequences)[:, -1]
+    seq_reward = jnp.asarray(seq_reward, jnp.float32)
+
+    # per-token reward = -KL penalty, terminal reward on the last token
+    kl = kl_penalty(old_logps, ref_lp, kl_coef)
+    rewards = (-kl).at[:, -1].add(seq_reward)
+
+    critic_model = engine._roles[ModelRole.CRITIC].model
+    critic_params = engine.state(ModelRole.CRITIC).params
+    values = _jitted_apply(critic_model)(
+        critic_params, sequences[:, :-1]
+    )[:, prompt_len - 1:]
+
+    dones = jnp.zeros_like(rewards).at[:, -1].set(1.0)
+    advantages, returns = gae_advantages(
+        rewards, values, dones, gamma=gamma, lam=lam
+    )
+
+    batch = {
+        "tokens": sequences,
+        "old_logprobs": old_logps,
+        "advantages": advantages,
+        "returns": returns,
+    }
+    losses = {}
+    for role in (ModelRole.ACTOR, ModelRole.CRITIC):
+        placed = engine.place_batch(role, batch)
+        state, metrics = engine.train_step(role)(
+            engine.state(role), placed
+        )
+        engine.set_state(role, state)
+        losses[f"{role}_loss"] = float(metrics["loss"])
+
+    return {
+        "mean_reward": float(seq_reward.mean()),
+        "mean_kl": float(kl.mean()),
+        **losses,
+    }

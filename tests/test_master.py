@@ -337,3 +337,75 @@ def test_create_master_kubernetes_composition():
         master.stop()
     finally:
         K8sClient.reset()
+
+
+def test_streaming_dataset_manager_dispatch_and_resume():
+    """Streaming shards keep flowing while earlier ones are in flight;
+    the checkpoint carries partition offsets so a restore resumes the
+    stream with un-acked shards re-queued (reference:
+    streaming_dataset_manager.py:204)."""
+    from dlrover_tpu.common.messages import DatasetShardParams
+    from dlrover_tpu.master.task_manager import (
+        StreamingDatasetManager,
+        TaskManager,
+    )
+
+    tm = TaskManager()
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="stream-ds", storage_type="stream",
+        batch_size=4, dataset_size=-1, num_epochs=1,
+        num_minibatches_per_shard=1,
+    ))
+    ds = tm._datasets["stream-ds"]
+    assert isinstance(ds, StreamingDatasetManager)
+
+    t1 = tm.get_dataset_task(0, "stream-ds")
+    # next fetch must produce a NEW shard even though t1 is in flight
+    t2 = tm.get_dataset_task(1, "stream-ds")
+    assert (t1.start, t1.end) == (0, 4)
+    assert (t2.start, t2.end) == (4, 8)
+    tm.report_dataset_task("stream-ds", t1.task_id, True)
+
+    state = tm.get_dataset_checkpoint("stream-ds")
+    # restore into a fresh manager: t2 was never acked -> re-queued
+    tm2 = TaskManager()
+    tm2.new_dataset(DatasetShardParams(
+        dataset_name="stream-ds", storage_type="stream",
+        batch_size=4, dataset_size=-1, num_epochs=1,
+        num_minibatches_per_shard=1,
+    ))
+    tm2.restore_dataset_from_checkpoint("stream-ds", state)
+    redo = tm2.get_dataset_task(2, "stream-ds")
+    assert (redo.start, redo.end) == (4, 8)
+    # and the stream continues PAST the checkpointed offsets
+    nxt = tm2.get_dataset_task(2, "stream-ds")
+    assert nxt.start >= 8
+    assert not tm2._datasets["stream-ds"].completed()
+
+
+def test_topology_sorted_rendezvous_world():
+    """Nodes from the same slice become rank-adjacent and the
+    coordinator is the topological first node (reference:
+    DpTopologySorter, net_topology.py:62)."""
+    from dlrover_tpu.master.net_topology import LabelTopologyQuerier
+
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(min_nodes=4, max_nodes=4)
+    q = LabelTopologyQuerier({
+        0: "slice1:0", 1: "slice0:1", 2: "slice1:1", 3: "slice0:0",
+    })
+    m.set_topology_querier(q)
+    for rank in range(4):
+        m.join_rendezvous(rank, rank, 4, f"10.0.0.{rank}")
+    _, _, world, coordinator = m.get_comm_world(0)
+    # slice0 hosts (3,1) first in host order, then slice1 (0,2)
+    assert list(world.keys()) == [3, 1, 0, 2]
+    assert coordinator.startswith("10.0.0.3:")
+
+    from dlrover_tpu.agent.training import RendezvousOutcome
+
+    outcome = RendezvousOutcome(round=1, world=world)
+    assert outcome.base_rank(3) == 0
+    assert outcome.base_rank(1) == 4
+    assert outcome.base_rank(0) == 8
+    assert outcome.base_rank(2) == 12

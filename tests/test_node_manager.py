@@ -222,7 +222,6 @@ def test_scaleplan_operator_roundtrip(k8s):
     assert len(api.pods) == 2
     pod = api.pods["tj-worker-0"]
     assert pod["metadata"]["labels"]["node-id"] == "0"
-    assert pod["metadata"]["ownerReferences"][0]["name"] == "tj"
     # idempotent: executed plans are skipped
     assert rec.reconcile_once() == 0
     assert api.create_calls == 2

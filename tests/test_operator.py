@@ -75,3 +75,24 @@ def test_crd_manifests_parse():
         "enableDynamicSharding", "replicaSpecs", "restartCount",
     ):
         assert fieldname in text
+
+
+def test_reconciler_gc_deletes_orphaned_pods():
+    from dlrover_tpu.operator.reconciler import ElasticJobReconciler
+    from dlrover_tpu.scheduler.kubernetes import K8sClient, MockK8sApi
+
+    api = MockK8sApi()
+    client = K8sClient(namespace="t", api=api)
+    rec = ElasticJobReconciler(client)
+    jobs = {
+        "j1": {"spec": {}, "metadata": {"uid": "uid-1"}},
+        "j2": {"spec": {}, "metadata": {"uid": "uid-2"}},
+    }
+    rec.reconcile_once(jobs)
+    assert len(api.pods) == 2
+    pod = api.pods["elasticjob-j1-master"]
+    ref = pod["metadata"]["ownerReferences"][0]
+    assert ref["kind"] == "ElasticJob" and ref["uid"] == "uid-1"
+    # job j2's CR deleted -> its master pod is garbage-collected
+    rec.reconcile_once({"j1": jobs["j1"]})
+    assert list(api.pods) == ["elasticjob-j1-master"]

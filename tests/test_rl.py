@@ -128,3 +128,111 @@ def test_rl_engine_four_roles_ppo_step():
         engine._frozen_params[ModelRole.REF]
     )[0]
     np.testing.assert_allclose(np.asarray(a), np.asarray(r))
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """Prefill + single-token decode steps reproduce the full-forward
+    logits (the KV-cache path is numerically the same policy)."""
+    from dlrover_tpu.rl.generation import decode_variant
+
+    cfg = GPTConfig.tiny()
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=16)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 10), dtype=np.int32
+        )
+    )
+    dec = decode_variant(model)
+    pre, vars_ = dec.apply({"params": params}, toks[:, :8],
+                           mutable=["cache"])
+    full = model.apply({"params": params}, toks)
+    np.testing.assert_allclose(
+        np.asarray(pre), np.asarray(full[:, :8]), atol=2e-2
+    )
+    cache = vars_["cache"]
+    for i in (8, 9):
+        logits, vars_ = dec.apply(
+            {"params": params, "cache": cache},
+            toks[:, i:i + 1], mutable=["cache"],
+        )
+        cache = vars_["cache"]
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, i]),
+            atol=2e-2,
+        )
+
+
+def test_ppo_iteration_improves_reward():
+    """Tiny end-to-end RLHF: reward = frequency of a target token in
+    the response; PPO iterations must raise it (rollout generation,
+    ref KL, GAE, actor+critic steps all wired through the engine)."""
+    import optax as _optax
+
+    from dlrover_tpu.accel import Strategy
+    from dlrover_tpu.rl.rollout import (
+        make_actor_loss,
+        make_critic_loss,
+        ppo_iteration,
+        sample_rollout_batch,
+    )
+
+    cfg = GPTConfig.tiny(max_seq_len=64, vocab_size=32)
+    actor_model = GPT(cfg)
+    critic_model = GPT(
+        GPTConfig.tiny(max_seq_len=64, vocab_size=32, head="value")
+    )
+    ref_model = GPT(cfg)
+
+    prompt_len, max_new = 4, 8
+    prompts = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, prompt_len), dtype=np.int32
+        )
+    )
+    sample = sample_rollout_batch(prompts, max_new)
+    dp = Strategy(opts=[("parallel_mode", {})])
+    actor_params = actor_model.init_params(jax.random.PRNGKey(1))
+    engine = RLModelEngine(sample, {
+        ModelRole.ACTOR: RoleSpec(
+            model=actor_model,
+            loss_fn=make_actor_loss(actor_model, prompt_len),
+            optim_factory=lambda: _optax.adam(5e-3),
+            strategy=dp,
+        ),
+        ModelRole.CRITIC: RoleSpec(
+            model=critic_model,
+            loss_fn=make_critic_loss(critic_model, prompt_len),
+            optim_factory=lambda: _optax.adam(1e-3),
+            strategy=dp,
+        ),
+        ModelRole.REF: RoleSpec(model=ref_model, params=actor_params),
+    }).build()
+
+    def reward_fn(sequences):
+        # dense signal: fraction of response tokens in the low half
+        # of the vocab (learnable within a few iterations)
+        resp = sequences[:, prompt_len:]
+        return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+    rng = jax.random.PRNGKey(2)
+    rewards = []
+    for i in range(12):
+        rng, sub = jax.random.split(rng)
+        metrics = ppo_iteration(
+            engine, prompts, sub, max_new_tokens=max_new,
+            kl_coef=0.01, reward_fn=reward_fn,
+        )
+        rewards.append(metrics["mean_reward"])
+    early = np.mean(rewards[:3])
+    late = np.mean(rewards[-3:])
+    assert late > early + 0.05, rewards
+    # ref sync is a real copy, not an alias of live actor params
+    engine.sync_ref_from_actor()
+    ref_leaf = jax.tree_util.tree_leaves(
+        engine._frozen_params[ModelRole.REF]
+    )[0]
+    actor_leaf = jax.tree_util.tree_leaves(
+        engine.state(ModelRole.ACTOR).params
+    )[0]
+    assert ref_leaf is not actor_leaf
