@@ -135,6 +135,62 @@ def bm_collective_probe(
     return elapsed
 
 
+def comm_perf_check(
+    payload_floats: int = 1 << 24, rounds: int = 4,
+) -> Optional[dict]:
+    """Fabric bandwidth report: algobw/busbw of a timed psum over the
+    visible devices (reference: ``comm_perf_check`` +
+    ``bm_allreduce``'s busbw accounting, node_check/utils.py:57-105 —
+    busbw = algbw * 2(n-1)/n for a ring allreduce)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return None
+    mesh = Mesh(np.array(devices), ("probe",))
+    per = max(128, payload_floats // n)
+    x = jax.device_put(
+        jnp.ones((n, per), jnp.float32),
+        NamedSharding(mesh, P("probe")),
+    )
+
+    def local(block):
+        return jax.lax.psum(block, "probe") / n
+
+    fn = jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=P("probe"),
+            out_specs=P("probe"),
+        )
+    )
+    out = fn(x)
+    float(out[0, 0])
+    start = time.perf_counter()
+    for _ in range(rounds):
+        out = fn(out)
+    float(out[0, 0])
+    elapsed = (time.perf_counter() - start) / rounds
+    # per-rank message size is what bandwidth math divides by (each
+    # rank reduces its own `per`-float block), matching the reference
+    # busbw convention (utils.py bm_allreduce)
+    message_bytes = per * 4
+    algbw = message_bytes / max(elapsed, 1e-9)
+    busbw = algbw * 2 * (n - 1) / n
+    report = {
+        "devices": n,
+        "payload_bytes": message_bytes,
+        "allreduce_s": round(elapsed, 6),
+        "algbw_gbps": round(algbw / 1e9, 3),
+        "busbw_gbps": round(busbw / 1e9, 3),
+    }
+    logger.info("comm perf: %s", report)
+    return report
+
+
 def bm_sync_barrier(
     client: MasterClient, round_id: int, world_size: int,
     timeout: float = 300.0,

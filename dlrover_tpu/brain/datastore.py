@@ -1,0 +1,100 @@
+"""Persistent job-metrics datastore (sqlite).
+
+Reference: the Go Brain's MySQL datastore
+(``go/brain/pkg/datastore/recorder/mysql/``) recording job metrics /
+job meta for the optimizer chain.  sqlite keeps the same durable,
+queryable role without an external server — the file lives on the
+master's PV (or local disk for single-job mode).
+"""
+
+import json
+import sqlite3
+import threading
+import time
+from typing import List, Optional
+
+from dlrover_tpu.brain.service import JobMetricRecord
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS job_metrics (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_name TEXT NOT NULL,
+    timestamp REAL NOT NULL,
+    workers INTEGER,
+    samples_per_sec REAL,
+    cpu_percent REAL,
+    memory_mb REAL,
+    model_params INTEGER,
+    finished INTEGER,
+    extra TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_job_name ON job_metrics (job_name);
+"""
+
+
+class SqliteJobMetricsStore:
+    """Drop-in for :class:`~dlrover_tpu.brain.service.JobMetricsStore`
+    with real persistence + indexed queries."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def persist(self, record: JobMetricRecord, **extra):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO job_metrics (job_name, timestamp, "
+                "workers, samples_per_sec, cpu_percent, memory_mb, "
+                "model_params, finished, extra) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    record.job_name,
+                    record.timestamp or time.time(),
+                    record.workers,
+                    record.samples_per_sec,
+                    record.cpu_percent,
+                    record.memory_mb,
+                    record.model_params,
+                    int(record.finished),
+                    json.dumps(extra) if extra else "",
+                ),
+            )
+            self._conn.commit()
+
+    def load(
+        self, job_name: Optional[str] = None
+    ) -> List[JobMetricRecord]:
+        query = (
+            "SELECT job_name, timestamp, workers, samples_per_sec, "
+            "cpu_percent, memory_mb, model_params, finished "
+            "FROM job_metrics"
+        )
+        args: tuple = ()
+        if job_name is not None:
+            query += " WHERE job_name = ?"
+            args = (job_name,)
+        with self._lock:
+            rows = self._conn.execute(query, args).fetchall()
+        return [
+            JobMetricRecord(
+                job_name=r[0], timestamp=r[1], workers=r[2] or 0,
+                samples_per_sec=r[3] or 0.0, cpu_percent=r[4] or 0.0,
+                memory_mb=r[5] or 0.0, model_params=r[6] or 0,
+                finished=bool(r[7]),
+            )
+            for r in rows
+        ]
+
+    def job_names(self) -> List[str]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT job_name FROM job_metrics"
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

@@ -65,11 +65,31 @@ class JobMetricsStore:
 
 
 class BrainService(ResourceOptimizer):
-    """History-driven resource optimization."""
+    """History-driven resource optimization.
 
-    def __init__(self, store: JobMetricsStore, job_name: str = ""):
+    The per-stage algorithm chain
+    (:mod:`dlrover_tpu.brain.optimizer_chain`) mirrors the Go Brain's
+    optalgorithm dispatch; the store can be the JSONL file here or
+    the sqlite datastore (:mod:`dlrover_tpu.brain.datastore`)."""
+
+    def __init__(self, store, job_name: str = "", chain=None):
+        from dlrover_tpu.brain.optimizer_chain import OptimizerChain
+
         self._store = store
         self._job_name = job_name
+        self._chain = chain or OptimizerChain()
+
+    def optimize_stage(self, stage: str, **ctx_fields) -> ResourcePlan:
+        """Run the stage's algorithm chain over the job history
+        (reference: Brain.optimize RPC -> optimizer chain)."""
+        from dlrover_tpu.brain.optimizer_chain import OptimizeContext
+
+        ctx = OptimizeContext(
+            job_name=self._job_name,
+            history=self._store.load(),
+            **ctx_fields,
+        )
+        return self._chain.optimize(stage, ctx)
 
     # -- client surface (reference: BrainClient.persist_metrics /
     #    get_optimization_plan) --------------------------------------------

@@ -59,6 +59,20 @@ class JobMaster:
                 min_nodes=node_num, max_nodes=node_num, node_unit=1
             )
             mngr.set_coordinator_port(coordinator_port)
+        # node-event callbacks (reference: event_callback.py objects)
+        from dlrover_tpu.master.event_callback import (
+            AllReduceNodeHandlingCallback,
+            TaskRescheduleCallback,
+        )
+
+        self.job_manager.add_event_callback(
+            TaskRescheduleCallback(self.task_manager)
+        )
+        self.job_manager.add_event_callback(
+            AllReduceNodeHandlingCallback(
+                self.elastic_rdzv, self.speed_monitor
+            )
+        )
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,

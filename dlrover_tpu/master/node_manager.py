@@ -60,13 +60,25 @@ class DistributedJobManager(JobManager):
         super().stop()
 
     def _init_nodes(self):
-        worker_args = self._job_args.node_args.get(NodeType.WORKER)
-        if worker_args is None:
-            return
-        for i in range(worker_args.group_resource.count):
-            node = self.add_node(NodeType.WORKER, i, rank=i)
-            node.config_resource = worker_args.group_resource.node_resource
-            node.max_relaunch_count = worker_args.restart_count
+        """Every declared node group (worker + evaluator flavours;
+        reference: per-type managers in master/node/worker.py)."""
+        next_id = 0
+        for node_type in (NodeType.WORKER, NodeType.EVALUATOR):
+            group = self._job_args.node_args.get(node_type)
+            if group is None:
+                continue
+            for i in range(group.group_resource.count):
+                node = self.add_node(node_type, next_id, rank=i)
+                # per-node copy so OOM bumps never leak into the
+                # shared group spec
+                import dataclasses as _dc
+
+                node.config_resource = _dc.replace(
+                    group.group_resource.node_resource
+                )
+                node.max_relaunch_count = group.restart_count
+                next_id += 1
+        self._id_iter = itertools.count(next_id)
 
     def _initial_plan(self) -> ScalePlan:
         plan = ScalePlan()
@@ -139,12 +151,19 @@ class DistributedJobManager(JobManager):
 
     def _relaunch_node(self, node: Node):
         """Reference: _relaunch_node, dist_job_manager.py:605 — a new
-        node id replaces the dead one at the same rank."""
+        node id replaces the dead one at the same rank AND type (a
+        dead evaluator comes back as an evaluator)."""
+        import dataclasses as _dc
+
         node.inc_relaunch_count()
         node.is_released = True
         new_id = next(self._id_iter)
         replacement = new_worker(new_id, rank=node.rank_index)
-        replacement.config_resource = node.config_resource
+        replacement.type = node.type
+        replacement.name = f"{node.type}-{new_id}"
+        # own copy: the OOM bump below must not mutate the group spec
+        # shared by other nodes
+        replacement.config_resource = _dc.replace(node.config_resource)
         replacement.relaunch_count = node.relaunch_count
         replacement.max_relaunch_count = node.max_relaunch_count
         with self._lock:
@@ -186,14 +205,24 @@ class DistributedJobManager(JobManager):
             and not n.is_released
         ]
         if target > len(alive):
+            import dataclasses as _dc
+
+            # ranks stay contiguous within the WORKER group even when
+            # evaluator ids interleave the id space
+            next_rank = 1 + max(
+                (n.rank_index for n in self.all_nodes().values()
+                 if n.type == NodeType.WORKER and not n.is_released),
+                default=-1,
+            )
             for _ in range(target - len(alive)):
                 new_id = next(self._id_iter)
-                node = new_worker(new_id, rank=new_id)
+                node = new_worker(new_id, rank=next_rank)
+                next_rank += 1
                 worker_args = self._job_args.node_args.get(
                     NodeType.WORKER
                 )
                 if worker_args:
-                    node.config_resource = (
+                    node.config_resource = _dc.replace(
                         worker_args.group_resource.node_resource
                     )
                 with self._lock:

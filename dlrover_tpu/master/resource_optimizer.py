@@ -19,6 +19,7 @@ from dlrover_tpu.master.speed_monitor import SpeedMonitor
 class ResourcePlan:
     worker_count: int = 0
     node_resources: Dict[str, Dict] = field(default_factory=dict)
+    memory_mb: int = 0  # per-node memory request override (OOM bump)
     comment: str = ""
 
 

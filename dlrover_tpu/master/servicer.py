@@ -198,19 +198,16 @@ class MasterServicer(RequestHandler):
             return True
 
         if isinstance(message, msg.NodeEventReport):
+            # membership/speed/shard-recycling side effects happen in
+            # the registered event callbacks (event_callback.py), not
+            # inline — one path for agent-reported and watcher-observed
+            # transitions alike
             self._job_manager.update_node_status(
                 message.node_id,
                 message.node_type,
                 message.status,
                 message.exit_reason,
             )
-            if message.status == "running":
-                self.elastic_rdzv.add_alive_node(message.node_id)
-                self._speed_monitor.add_running_worker(message.node_id)
-            elif message.status in ("failed", "deleted", "succeeded"):
-                self.elastic_rdzv.remove_alive_node(message.node_id)
-                self._speed_monitor.remove_running_worker(message.node_id)
-                self._task_manager.recycle_worker_tasks(message.node_id)
             return True
 
         if isinstance(message, msg.NodeResourceStats):

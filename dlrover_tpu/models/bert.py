@@ -1,0 +1,161 @@
+"""BERT-family bidirectional encoder.
+
+Reference: ATorch's hand-parallelized HF encoder blocks
+(``modules/distributed_modules/transformer.py:45-1742`` covers
+Bert/CLIP/GLM attention+MLP+stacks).  The TPU rebuild needs no
+per-architecture parallel modules: this encoder reuses the same
+parameter naming contract as :mod:`dlrover_tpu.models.gpt`
+(``qkv``/``o_proj``/``fc_in``/``fc_out``/``wte``...), so the
+rule-driven GSPMD shardings (``gpt_tp_rules``) parallelize it
+unchanged — the registry-of-modules problem dissolves into naming.
+"""
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528  # padded to a multiple of 64
+    max_seq_len: int = 512
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_dim: int = 768
+    mlp_ratio: int = 4
+    num_segments: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    num_labels: int = 0  # >0 adds a classification head
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_dim // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        defaults = dict(
+            vocab_size=256, max_seq_len=128, num_layers=2,
+            num_heads=4, hidden_dim=64,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+
+def bidirectional_attention(q, k, v, mask=None, dtype=jnp.bfloat16):
+    """Full (non-causal) attention; ``mask`` [b, s] marks valid
+    tokens."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        logits = jnp.where(
+            mask[:, None, None, :].astype(bool), logits, -1e30
+        )
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class EncoderBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.config
+        b, s, d = x.shape
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        qkv = nn.Dense(
+            3 * d, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="qkv",
+        )(h.astype(cfg.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        attn = bidirectional_attention(
+            q, k, v, mask=mask, dtype=cfg.dtype
+        ).reshape(b, s, d)
+        x = x + nn.Dense(
+            d, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="o_proj",
+        )(attn)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        h = nn.Dense(
+            cfg.mlp_ratio * d, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="fc_in",
+        )(h.astype(cfg.dtype))
+        h = nn.gelu(h)
+        return x + nn.Dense(
+            d, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="fc_out",
+        )(h)
+
+
+class Bert(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, segment_ids=None, mask=None):
+        cfg = self.config
+        b, s = tokens.shape
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.max_seq_len, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wpe",
+        )
+        wse = nn.Embed(
+            cfg.num_segments, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wse",
+        )
+        if segment_ids is None:
+            segment_ids = jnp.zeros_like(tokens)
+        x = (
+            wte(tokens)
+            + wpe(jnp.arange(s)[None])
+            + wse(segment_ids)
+        )
+        block = EncoderBlock
+        if cfg.remat:
+            block = nn.remat(EncoderBlock, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x, mask)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if cfg.num_labels:
+            # [CLS]-style pooled classification head
+            pooled = jnp.tanh(nn.Dense(
+                cfg.hidden_dim, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="pooler",
+            )(x[:, 0].astype(cfg.dtype)))
+            return nn.Dense(
+                cfg.num_labels, dtype=jnp.float32,
+                param_dtype=cfg.param_dtype, name="classifier",
+            )(pooled)
+        # MLM logits over the tied vocabulary
+        return wte.attend(x.astype(cfg.dtype)).astype(jnp.float32)
+
+    def init_params(self, rng, batch_size: int = 2, seq_len: int = 0):
+        seq_len = seq_len or min(self.config.max_seq_len, 128)
+        tokens = jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+
+def mlm_loss(logits, targets, mask):
+    """Masked-LM cross entropy over masked positions only."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[
+        ..., 0
+    ]
+    mask = mask.astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

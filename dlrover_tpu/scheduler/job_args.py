@@ -57,6 +57,7 @@ def new_job_args(
     min_nodes: int = 0,
     max_nodes: int = 0,
     node_unit: int = 1,
+    num_evaluators: int = 0,
 ) -> JobArgs:
     args = JobArgs(
         platform=platform,
@@ -75,4 +76,18 @@ def new_job_args(
             ),
         )
     )
+    if num_evaluators:
+        # evaluator flavour (reference: EvaluatorManager,
+        # node/worker.py:66): side nodes running eval loops — outside
+        # the training rendezvous, relaunched but never auto-scaled
+        args.node_args[NodeType.EVALUATOR] = NodeArgs(
+            group_resource=NodeGroupResource(
+                count=num_evaluators,
+                node_resource=NodeResource(
+                    cpu=8, memory_mb=32 * 1024,
+                    chips=chips_per_node, chip_type="tpu",
+                ),
+            ),
+            auto_scale=False,
+        )
     return args

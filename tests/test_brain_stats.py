@@ -1,0 +1,139 @@
+"""Brain datastore/optimizer chain, job stats collector, node-event
+callbacks — the master-side observability + Brain parity pieces
+(reference: go/brain optalgorithm chain, master/stats/,
+master/node/event_callback.py)."""
+
+import jax.numpy as jnp  # noqa: F401 (jax init before threads)
+import numpy as np
+import pytest
+
+from dlrover_tpu.brain.datastore import SqliteJobMetricsStore
+from dlrover_tpu.brain.optimizer_chain import (
+    JobStage,
+    OptimizeContext,
+    OptimizerChain,
+)
+from dlrover_tpu.brain.service import BrainService, JobMetricRecord
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.node import Node, NodeEvent
+from dlrover_tpu.master.event_callback import (
+    AllReduceNodeHandlingCallback,
+    TaskRescheduleCallback,
+)
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.stats import (
+    BrainStatsReporter,
+    JobMetricCollector,
+    emit_k8s_event,
+)
+
+
+def _records():
+    return [
+        JobMetricRecord("old-a", 1.0, workers=4,
+                        samples_per_sec=400, model_params=1000,
+                        finished=True),
+        JobMetricRecord("old-b", 2.0, workers=8,
+                        samples_per_sec=480, model_params=1000,
+                        finished=True),
+        JobMetricRecord("me", 3.0, workers=2, samples_per_sec=100),
+        JobMetricRecord("me", 4.0, workers=4, samples_per_sec=280),
+    ]
+
+
+def test_sqlite_store_roundtrip(tmp_path):
+    store = SqliteJobMetricsStore(str(tmp_path / "brain.db"))
+    for r in _records():
+        store.persist(r)
+    assert sorted(store.job_names()) == ["me", "old-a", "old-b"]
+    me = store.load("me")
+    assert len(me) == 2 and me[0].workers == 2
+    # durable across re-open
+    store.close()
+    store2 = SqliteJobMetricsStore(str(tmp_path / "brain.db"))
+    assert len(store2.load()) == 4
+    store2.close()
+
+
+def test_optimizer_chain_stages(tmp_path):
+    store = SqliteJobMetricsStore(str(tmp_path / "b.db"))
+    for r in _records():
+        store.persist(r)
+    brain = BrainService(store, job_name="me")
+    create = brain.optimize_stage(
+        JobStage.CREATE, model_params=1000, current_workers=0
+    )
+    assert create.worker_count == 4  # old-a has best per-worker rate
+    running = brain.optimize_stage(
+        JobStage.RUNNING, current_workers=2
+    )
+    assert running.worker_count == 4  # 280/4 > 100/2... probe logic
+    oom = brain.optimize_stage(
+        JobStage.OOM, current_workers=4, memory_mb=1000
+    )
+    assert oom.memory_mb == 1500
+    store.close()
+
+
+def test_utilization_scale_down():
+    chain = OptimizerChain()
+    plan = chain.optimize(JobStage.RUNNING, OptimizeContext(
+        job_name="x", current_workers=8, chip_util=0.1,
+    ))
+    assert plan.worker_count == 4
+
+
+def test_stats_collector_and_brain_reporter(tmp_path):
+    sm = SpeedMonitor()
+    sm.set_batch_size(32)
+    sm.set_model_flops(1e9, 1e14)
+    import time as _t
+    now = _t.time()
+    for i in range(10):
+        sm.collect_global_step(i * 10, now + i)
+    store = SqliteJobMetricsStore(str(tmp_path / "s.db"))
+    collector = JobMetricCollector(
+        "j", sm, reporter=BrainStatsReporter(store, "j"),
+    )
+    collector.collect_model_info(123456)
+    collector.collect_node_resource(0, {"cpu": 50.0})
+    snap = collector.snapshot()
+    assert snap.samples_per_sec > 0
+    assert snap.mfu > 0
+    assert 0 < snap.goodput <= 1.0
+    collector.report_once()
+    recs = store.load("j")
+    assert len(recs) == 1 and recs[0].model_params == 123456
+    store.close()
+
+
+def test_event_callbacks_fire(tmp_path):
+    from dlrover_tpu.master.master import JobMaster
+    from dlrover_tpu.scheduler.kubernetes import K8sClient, MockK8sApi
+
+    master = JobMaster(port=0, node_num=2, job_name="cb")
+    try:
+        recycled = []
+        master.task_manager.recycle_worker_tasks = recycled.append
+        master.job_manager.update_node_status(3, "worker",
+                                              NodeStatus.RUNNING)
+        assert 3 in master.elastic_rdzv._alive_nodes
+        assert 3 in master.speed_monitor.running_workers
+        master.job_manager.update_node_status(
+            3, "worker", NodeStatus.FAILED, exit_reason="oom"
+        )
+        assert recycled == [3]
+        assert 3 not in master.elastic_rdzv._alive_nodes
+        assert 3 not in master.speed_monitor.running_workers
+    finally:
+        master.stop()
+
+    # k8s event emission shape
+    api = MockK8sApi()
+    client = K8sClient(namespace="t", api=api)
+    assert emit_k8s_event(client, "cb", "NodeFailed", "node 3 oom")
+    events = [
+        v for k, v in api.custom_resources.items()
+        if k.startswith("events/")
+    ]
+    assert events and events[0]["reason"] == "NodeFailed"

@@ -101,3 +101,35 @@ def test_mup_width_multipliers_and_transfer():
     w_step = float(np.abs(np.asarray(updates["w"])).mean())
     b_step = float(np.abs(np.asarray(updates["b"])).mean())
     assert w_step == pytest.approx(b_step / 4.0, rel=1e-3)
+
+
+def test_profiler_trace_capture_and_parse(tmp_path):
+    """XLA profile of a real computation parses into per-op self
+    times (reference: parse_trace_json.py tooling)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.utils.profiler import parse_trace_dir, trace
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((256, 256))
+    float(f(x))  # compile outside the trace
+    with trace(str(tmp_path)):
+        float(f(x))
+    summary = parse_trace_dir(str(tmp_path))
+    assert summary.op_self_time_us, "no trace events parsed"
+    assert summary.total_duration_us > 0
+    assert summary.top_ops(3)
+
+
+def test_comm_perf_check_reports_bandwidth():
+    from dlrover_tpu.agent.node_check import comm_perf_check
+
+    report = comm_perf_check(payload_floats=1 << 16, rounds=2)
+    assert report is not None
+    assert report["devices"] == 8
+    assert report["algbw_gbps"] > 0
+    assert report["busbw_gbps"] > report["algbw_gbps"]

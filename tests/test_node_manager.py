@@ -307,3 +307,40 @@ def test_scaleplan_watcher_skips_master_origin_plans(k8s):
     assert rec.reconcile_once() == 1     # operator executes it
     assert rec.reconcile_once() == 0     # terminal for the operator
     assert watcher.reconcile_once() == 0  # still terminal for master
+
+
+def test_evaluator_node_group(k8s):
+    """Evaluator flavour: side nodes are created and relaunched but
+    never swept into worker auto-scaling (reference:
+    EvaluatorManager, node/worker.py:66)."""
+    client, api = k8s
+    args = new_job_args(
+        platform="kubernetes", job_name="tj", num_workers=2,
+        num_evaluators=1,
+    )
+    scaler = PodScaler("tj", client, master_addr="1.2.3.4:5")
+    mgr = DistributedJobManager(args, scaler)
+    mgr._watcher = PodWatcher("tj", client, mgr.process_event)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 3)
+        assert "tj-evaluator-2" in api.pods
+        for name in list(api.pods):
+            api.set_pod_phase(name, "Running")
+        _wait_until(lambda: all(
+            n.status == NodeStatus.RUNNING
+            for n in mgr.all_nodes().values()
+        ))
+        plan = mgr.adjust_worker_count(4)
+        assert len(plan.launch_nodes) == 2
+        assert all(
+            n.type == NodeType.WORKER for n in plan.launch_nodes
+        )
+        evaluators = [
+            n for n in mgr.all_nodes().values()
+            if n.type == NodeType.EVALUATOR
+        ]
+        assert len(evaluators) == 1
+        assert not evaluators[0].is_released
+    finally:
+        mgr.stop()

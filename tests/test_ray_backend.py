@@ -1,0 +1,50 @@
+"""Ray substrate: actor-based scaler/watcher against the mock API
+(reference: scheduler/ray.py:60, ray_scaler.py, ray_watcher.py)."""
+
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.node import new_worker
+from dlrover_tpu.master.scaler import ScalePlan
+from dlrover_tpu.scheduler.ray_backend import (
+    MockRayApi,
+    RayClient,
+    RayScaler,
+    RayWatcher,
+)
+
+
+def test_ray_scaler_creates_and_kills_actors():
+    api = MockRayApi()
+    client = RayClient("rj", api=api)
+    scaler = RayScaler(client)
+    scaler.scale(ScalePlan(
+        launch_nodes=[new_worker(0, rank=0), new_worker(1, rank=1)]
+    ))
+    assert set(api.actors) == {"rj-worker-0", "rj-worker-1"}
+    nodes = client.list_nodes()
+    assert {n.id for n in nodes} == {0, 1}
+    assert all(n.status == NodeStatus.RUNNING for n in nodes)
+    scaler.scale(ScalePlan(remove_nodes=[new_worker(1, rank=1)]))
+    assert set(api.actors) == {"rj-worker-0"}
+
+
+def test_ray_watcher_emits_state_changes():
+    api = MockRayApi()
+    client = RayClient("rj", api=api)
+    events = []
+    watcher = RayWatcher(client, events.append)
+    RayScaler(client).scale(
+        ScalePlan(launch_nodes=[new_worker(0, rank=0)])
+    )
+    watcher.poll_once()
+    assert len(events) == 1
+    assert events[0].node.status == NodeStatus.RUNNING
+    api.set_actor_state("rj-worker-0", "DEAD")
+    watcher.poll_once()
+    assert events[-1].node.status == NodeStatus.FAILED
+    # an ALIVE actor disappearing entirely -> synthesized failure
+    api.set_actor_state("rj-worker-0", "ALIVE")
+    watcher.poll_once()
+    api.actors.clear()
+    watcher.poll_once()
+    assert events[-1].node.exit_reason == "actor-gone"
+    assert events[-1].node.status == NodeStatus.FAILED
