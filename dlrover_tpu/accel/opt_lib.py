@@ -95,8 +95,12 @@ class TensorParallelOpt(Optimization):
     semiauto = True
 
     def apply(self, plan, config, context=None):
+        from dlrover_tpu.parallel.registry import rules_for_model
+
         plan.mesh_config.tensor = int(config.get("size", 2))
-        plan.param_rules = gpt_tp_rules()
+        plan.param_rules = rules_for_model(
+            getattr(context, "model", None), use_moe=False
+        )
         plan.notes.append(
             f"tensor parallel x{plan.mesh_config.tensor}"
         )
@@ -127,8 +131,12 @@ class ExpertParallelOpt(Optimization):
     semiauto = True
 
     def apply(self, plan, config, context=None):
+        from dlrover_tpu.parallel.registry import rules_for_model
+
         plan.mesh_config.expert = int(config.get("size", 2))
-        plan.param_rules = moe_rules()
+        plan.param_rules = rules_for_model(
+            getattr(context, "model", None), use_moe=True
+        )
         plan.notes.append(
             f"expert parallel x{plan.mesh_config.expert}"
         )
@@ -143,14 +151,17 @@ class MixedParallelOpt(Optimization):
     semiauto = True
 
     def apply(self, plan, config, context=None):
+        from dlrover_tpu.parallel.registry import rules_for_model
+
         mc = plan.mesh_config
         mc.tensor = int(config.get("tensor", 1))
         mc.fsdp = int(config.get("fsdp", 1))
         mc.sequence = int(config.get("sequence", 1))
         mc.expert = int(config.get("expert", 1))
         mc.data = int(config.get("data", -1))
-        plan.param_rules = (
-            moe_rules() if mc.expert > 1 else gpt_tp_rules()
+        plan.param_rules = rules_for_model(
+            getattr(context, "model", None),
+            use_moe=True if mc.expert > 1 else None,
         )
         plan.notes.append(f"mixed parallel {mc}")
         return plan

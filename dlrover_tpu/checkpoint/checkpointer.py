@@ -35,8 +35,14 @@ class Checkpointer:
         checkpoint_dir: str,
         replicated: bool = True,
         deletion_keep_latest: int = 0,
+        orbax_dir: str = "",
+        orbax_every: int = 0,
         **engine_kwargs,
     ):
+        """``orbax_dir`` + ``orbax_every``: additionally write every
+        Nth storage save through the orbax tier — the re-shardable
+        durable copy a topology change restores from (reference: the
+        DCP/dist-ckpt tier next to flash saves)."""
         self.checkpoint_dir = checkpoint_dir
         self._engine = CheckpointEngine(
             checkpoint_dir,
@@ -44,6 +50,18 @@ class Checkpointer:
             deletion_keep_latest=deletion_keep_latest,
             **engine_kwargs,
         )
+        self._orbax_dir = orbax_dir
+        self._orbax_every = orbax_every
+        self._orbax = None
+
+    def _orbax_tier(self):
+        if self._orbax is None and self._orbax_dir:
+            from dlrover_tpu.checkpoint.orbax_compat import (
+                GlobalCheckpointer,
+            )
+
+            self._orbax = GlobalCheckpointer(self._orbax_dir)
+        return self._orbax
 
     def save_checkpoint(
         self,
@@ -54,7 +72,18 @@ class Checkpointer:
     ) -> bool:
         if storage_type == StorageType.MEMORY:
             return self._engine.save_to_memory(step, state_dict, path)
-        return self._engine.save_to_storage(step, state_dict, path)
+        ok = self._engine.save_to_storage(step, state_dict, path)
+        # the durable tier is independent of the flash tier: a flash
+        # save skipped as busy must not starve the orbax cadence
+        if (
+            self._orbax_every
+            and step % self._orbax_every == 0
+            and self._orbax_tier() is not None
+        ):
+            # async inside orbax; jax.Array immutability makes the
+            # concurrent snapshot safe
+            self._orbax_tier().save(step, state_dict)
+        return ok
 
     def load_checkpoint(
         self, target_state: Any = None, orbax_dir: str = "",
@@ -66,15 +95,21 @@ class Checkpointer:
         ``orbax_dir`` (reference: fsdp_engine re-shard on load)."""
         if target_state is not None:
             return self._engine.load_sharded(
-                target_state, orbax_dir=orbax_dir
+                target_state, orbax_dir=orbax_dir or self._orbax_dir
             )
         return self._engine.load()
 
     def wait(self, timeout: float = 600.0) -> bool:
         """Block until in-flight async snapshot writes reach shared
-        memory (call before process exit so the last save is
-        restorable)."""
-        return self._engine.wait_async(timeout=timeout)
+        memory AND in-flight orbax tier writes complete (call before
+        process exit so the last save is restorable)."""
+        ok = self._engine.wait_async(timeout=timeout)
+        if self._orbax is not None:
+            self._orbax.wait()
+        return ok
 
     def close(self):
+        if self._orbax is not None:
+            self._orbax.wait()
+            self._orbax.close()
         self._engine.close()

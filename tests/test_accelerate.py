@@ -224,3 +224,41 @@ def test_fp8_opt_and_model_path():
     placed = result.place_batch(batch)
     _, metrics = result.train_step(result.state, placed)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_tp_rules_registry_resolution():
+    """Model-family registry resolves custom rules; unknown families
+    fall back to the shared transformer contract (reference role:
+    modules_registry.py)."""
+    from dlrover_tpu.models.bert import Bert, BertConfig
+    from dlrover_tpu.parallel.registry import (
+        register_tp_rules,
+        rules_for_model,
+    )
+    from dlrover_tpu.parallel.sharding import (
+        PartitionRules,
+        gpt_tp_rules,
+    )
+
+    bert = Bert(BertConfig.tiny())
+    # unknown family -> shared contract
+    assert rules_for_model(bert).rules == gpt_tp_rules().rules
+
+    custom = PartitionRules(rules=[(r"special", ("tensor",))])
+    register_tp_rules("Bert", custom)
+    try:
+        assert rules_for_model(bert) is custom
+        # the opt library picks it up through the context
+        lib = OptimizationLibrary()
+        ctx = ModelContext(
+            model=bert, optim_factory=lambda: optax.sgd(0.1),
+            loss_fn=lambda p, b: 0.0, sample_batch={},
+        )
+        plan = lib.apply_strategy(
+            Strategy(opts=[("tensor_parallel", {"size": 2})]), ctx
+        )
+        assert plan.param_rules is custom
+    finally:
+        from dlrover_tpu.parallel.registry import unregister_tp_rules
+
+        unregister_tp_rules("Bert")

@@ -181,3 +181,25 @@ def test_agent_worker_succeeds_after_one_restart(master, client, tmp_path):
         spec, client=client, node_rank=0, start_monitors=False
     )
     assert agent.run() == 0
+
+
+def test_starter_builds_tpurun_argv():
+    """Platform starter: NodeEnv contract -> tpurun argv (reference:
+    platform/starter.py:94)."""
+    from dlrover_tpu.common.constants import NodeEnv
+    from dlrover_tpu.trainer.starter import build_run_argv
+
+    env = {
+        NodeEnv.NODE_NUM: "4",
+        NodeEnv.LOCAL_WORLD_SIZE: "4",
+        NodeEnv.NODE_RANK: "2",
+        "DLROVER_MIN_NODES": "2",
+        "DLROVER_MAX_NODES": "4",
+        "DLROVER_NETWORK_CHECK": "1",
+    }
+    argv = build_run_argv(["train.py", "--lr", "0.1"], env=env)
+    assert argv[:2] == ["--nnodes", "2:4"]
+    assert "--nproc_per_node" in argv and "4" in argv
+    assert "--node_rank" in argv and "2" in argv
+    assert "--network-check" in argv
+    assert argv[-3:] == ["train.py", "--lr", "0.1"]
