@@ -53,6 +53,7 @@ class Checkpointer:
         self._orbax_dir = orbax_dir
         self._orbax_every = orbax_every
         self._orbax = None
+        self._storage_saves = 0
 
     def _orbax_tier(self):
         if self._orbax is None and self._orbax_dir:
@@ -74,10 +75,13 @@ class Checkpointer:
             return self._engine.save_to_memory(step, state_dict, path)
         ok = self._engine.save_to_storage(step, state_dict, path)
         # the durable tier is independent of the flash tier: a flash
-        # save skipped as busy must not starve the orbax cadence
+        # save skipped as busy must not starve the orbax cadence, and
+        # the cadence counts SAVES (not raw step numbers, which may
+        # never hit the modulo)
+        self._storage_saves += 1
         if (
             self._orbax_every
-            and step % self._orbax_every == 0
+            and (self._storage_saves - 1) % self._orbax_every == 0
             and self._orbax_tier() is not None
         ):
             # async inside orbax; jax.Array immutability makes the
@@ -97,15 +101,33 @@ class Checkpointer:
             return self._engine.load_sharded(
                 target_state, orbax_dir=orbax_dir or self._orbax_dir
             )
-        return self._engine.load()
+        step, state = self._engine.load()
+        if step is None and (orbax_dir or self._orbax_dir):
+            # shm + flash storage gone (node replacement): the
+            # configured durable tier is the last resort even without
+            # a target template
+            tier = self._orbax_tier()
+            if tier is not None:
+                return tier.restore()
+        return step, state
 
     def wait(self, timeout: float = 600.0) -> bool:
         """Block until in-flight async snapshot writes reach shared
         memory AND in-flight orbax tier writes complete (call before
-        process exit so the last save is restorable)."""
+        process exit so the last save is restorable).  The timeout
+        bounds the whole call — a hung remote store cannot block a
+        preemption grace period."""
+        import threading
+        import time as _time
+
+        start = _time.monotonic()
         ok = self._engine.wait_async(timeout=timeout)
         if self._orbax is not None:
-            self._orbax.wait()
+            remaining = max(0.1, timeout - (_time.monotonic() - start))
+            t = threading.Thread(target=self._orbax.wait, daemon=True)
+            t.start()
+            t.join(timeout=remaining)
+            ok = ok and not t.is_alive()
         return ok
 
     def close(self):

@@ -212,13 +212,17 @@ class SharedMemoryHandler:
                 self._shm = get_or_create_shm(self._shm_name, total)
             config.writing = True
             self._publish_meta(metas, config, offset, len(scalar_blob))
+            from dlrover_tpu.ops.fastcopy import copy_into
+
             buf = self._shm.buf
             for key, arr in arrays.items():
                 m = metas[key]
                 dst = np.frombuffer(
                     buf, dtype=arr.dtype, count=arr.size, offset=m.offset
                 ).reshape(arr.shape)
-                np.copyto(dst, arr)
+                # GIL released during the memcpy: a multi-GB snapshot
+                # must not starve heartbeat/IPC threads
+                copy_into(dst, arr)
             buf[offset:offset + len(scalar_blob)] = scalar_blob
             config.writing = False
             self._publish_meta(metas, config, offset, len(scalar_blob))

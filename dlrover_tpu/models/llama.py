@@ -34,6 +34,10 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attention_impl: str = "xla"
+    # KV-cache decoding (same contract as GPTConfig.decode): RoPE uses
+    # absolute positions continued across chunks; the cache stores
+    # post-RoPE keys at kv-head granularity (GQA-aware)
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -91,6 +95,34 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def gqa_decode_attention(
+    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+    q_pos: jax.Array, dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Chunked decode attention against a kv-head-granular cache.
+
+    ``q``: [b, s_new, num_heads, d]; ``k_cache``/``v_cache``:
+    [b, max_len, num_kv_heads, d].  Query heads are folded into
+    (kv_head, group) so the cache is never expanded (the whole point
+    of GQA); masks causality + the unfilled cache tail.
+    """
+    b, s, h, d = q.shape
+    kvh = k_cache.shape[2]
+    group = h // kvh
+    qg = q.reshape(b, s, kvh, group, d)
+    scale = d**-0.5
+    logits = jnp.einsum(
+        "bqkgd,bmkd->bkgqm", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    k_pos = jnp.arange(k_cache.shape[1])
+    mask = k_pos[None, :] <= q_pos[:, None]  # [s_new, max_len]
+    logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
+    return out.reshape(b, s, h, d)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -112,16 +144,50 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype, name="v_proj",
         )(x).reshape(b, s, cfg.num_kv_heads, hd)
 
-        positions = jnp.arange(s)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        if cfg.num_kv_heads != cfg.num_heads:
-            group = cfg.num_heads // cfg.num_kv_heads
-            k = jnp.repeat(k, group, axis=2)
-            v = jnp.repeat(v, group, axis=2)
+        if cfg.decode:
+            cache_shape = (
+                b, cfg.max_seq_len, cfg.num_kv_heads, hd
+            )
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros(cache_shape, k.dtype),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros(cache_shape, v.dtype),
+            )
+            idx = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            pos = idx.value
+            positions = pos + jnp.arange(s)
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, pos, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, pos, 0, 0)
+            )
+            idx.value = pos + s
+            # GQA-aware: the cache stays at kv-head granularity; q is
+            # folded to [b, s, kv_heads, group, d] instead of
+            # expanding the whole cache every decode step
+            out = gqa_decode_attention(
+                q, ck.value, cv.value, positions, dtype=cfg.dtype
+            )
+        else:
+            positions = jnp.arange(s)
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            if cfg.num_kv_heads != cfg.num_heads:
+                group = cfg.num_heads // cfg.num_kv_heads
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
 
-        attn_fn = get_attention_fn(cfg.attention_impl)
-        out = attn_fn(q, k, v, dtype=cfg.dtype)
+            attn_fn = get_attention_fn(cfg.attention_impl)
+            out = attn_fn(q, k, v, dtype=cfg.dtype)
         out = out.reshape(b, s, cfg.num_heads * hd)
         return nn.Dense(
             cfg.hidden_dim, use_bias=False, dtype=cfg.dtype,

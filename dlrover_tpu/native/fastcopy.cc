@@ -1,0 +1,24 @@
+// GIL-free bulk memcpy for the flash-checkpoint shm path.
+//
+// Reference capability: the reference's hot shm copy
+// (_traverse_copy_to_shm, ckpt_saver.py:174) runs torch's C++ memcpy
+// which drops the GIL.  numpy's copyto holds the GIL for the whole
+// transfer, so a multi-GB snapshot written by the async writer thread
+// starves every other thread in the trainer (heartbeats, IPC replies)
+// for seconds on low-memory-bandwidth hosts.  This copies in chunks
+// through a plain C ABI; the Python binding releases the GIL around
+// the call (ctypes does this automatically for foreign calls).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Copy n bytes from src to dst.  Returns n.
+size_t dlrover_fastcopy(void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,61 @@
+"""GIL-free bulk copies for the checkpoint hot path.
+
+ctypes foreign calls release the GIL, so routing the flat
+array->shm memcpy through the tiny native helper keeps the trainer's
+other threads (heartbeats, IPC replies, monitors) responsive while a
+multi-GB snapshot streams — the reference gets this for free from
+torch's C++ copy (ckpt_saver.py:174); numpy's ``copyto`` holds the
+GIL the whole time.  Falls back to numpy when the toolchain is
+unavailable.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    try:
+        from dlrover_tpu.native import build_library
+
+        lib = ctypes.CDLL(build_library("fastcopy"))
+        lib.dlrover_fastcopy.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.dlrover_fastcopy.restype = ctypes.c_size_t
+        _lib = lib
+    except Exception as e:  # noqa: BLE001 - no toolchain etc.
+        logger.info("fastcopy unavailable (%s); using numpy", e)
+        _lib = None
+    return _lib
+
+
+def copy_into(dst: np.ndarray, src: np.ndarray) -> None:
+    """dst[...] = src with the GIL released during the transfer.
+
+    Both must be C-contiguous with identical dtype/size (the
+    checkpoint path guarantees this); falls back to ``np.copyto``.
+    """
+    lib = _load()
+    if (
+        lib is None
+        or not dst.flags["C_CONTIGUOUS"]
+        or not src.flags["C_CONTIGUOUS"]
+        or dst.dtype != src.dtype
+        or dst.size != src.size
+    ):
+        np.copyto(dst, src)
+        return
+    lib.dlrover_fastcopy(
+        dst.ctypes.data, src.ctypes.data, dst.nbytes
+    )

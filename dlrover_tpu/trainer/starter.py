@@ -48,7 +48,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument("script_and_args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(argv)
-    rest = [a for a in ns.script_and_args if a != "--"]
+    rest = list(ns.script_and_args)
+    if rest and rest[0] == "--":  # only the leading separator
+        rest = rest[1:]
     if not rest:
         parser.error("training script required after --")
     master_addr = os.getenv(NodeEnv.MASTER_ADDR, "")

@@ -231,3 +231,45 @@ def test_async_snapshot_skips_when_busy(saver, tmp_path):
     step, _ = engine.load()
     assert step == 4
     engine.close()
+
+
+def test_fastcopy_gil_release_and_correctness():
+    """The native copy matches numpy and keeps other threads running
+    during a large transfer (the GIL-starvation fix)."""
+    import threading
+    import time as _time
+
+    from dlrover_tpu.ops.fastcopy import _load, copy_into
+
+    src = np.random.default_rng(0).normal(size=(400, 1024, 64)).astype(
+        np.float32
+    )  # ~100 MB
+    dst = np.empty_like(src)
+    copy_into(dst, src)
+    np.testing.assert_array_equal(dst, src)
+
+    if _load() is None:
+        pytest.skip("no native toolchain")
+    # tick thread must keep running while the copy is in flight
+    ticks = []
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            ticks.append(_time.perf_counter())
+            _time.sleep(0.001)
+
+    t = threading.Thread(target=ticker, daemon=True)
+    t.start()
+    _time.sleep(0.02)
+    t0 = _time.perf_counter()
+    for _ in range(5):
+        copy_into(dst, src)
+    elapsed = _time.perf_counter() - t0
+    stop.set()
+    t.join(timeout=2)
+    during = [x for x in ticks if t0 <= x <= t0 + elapsed]
+    # with the GIL released the ticker runs throughout the copies
+    assert len(during) >= max(3, int(elapsed / 0.01)), (
+        len(during), elapsed
+    )

@@ -208,10 +208,17 @@ def test_checkpointer_orbax_tier_roundtrip(saver, tmp_path):
     ckpt._orbax_tier().wait()
     ckpt.close()
 
-    # everything flash-tier is wiped; restore must come from orbax
+    # everything flash-tier is wiped (disk AND the persistent shm
+    # snapshot, which survives close() by design); restore must come
+    # from orbax
     import shutil
 
     shutil.rmtree(str(tmp_path / "flash"), ignore_errors=True)
+    from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+
+    h = SharedMemoryHandler(0, host=False)
+    h.unlink()
+    h.close()
     ckpt2 = Checkpointer(
         str(tmp_path / "flash2"), replicated=False,
         local_rank=0, global_rank=0, world_size=1,
