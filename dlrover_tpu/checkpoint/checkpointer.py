@@ -53,6 +53,8 @@ class Checkpointer:
         self._orbax_dir = orbax_dir
         self._orbax_every = orbax_every
         self._orbax = None
+        self._orbax_waiter = None
+        self._orbax_hung = False
         self._storage_saves = 0
 
     def _orbax_tier(self):
@@ -104,8 +106,19 @@ class Checkpointer:
         step, state = self._engine.load()
         if step is None and (orbax_dir or self._orbax_dir):
             # shm + flash storage gone (node replacement): the
-            # configured durable tier is the last resort even without
-            # a target template
+            # durable tier is the last resort even without a target
+            # template; a per-call orbax_dir overrides the configured
+            # one (mirrors the target_state branch)
+            if orbax_dir and orbax_dir != self._orbax_dir:
+                from dlrover_tpu.checkpoint.orbax_compat import (
+                    GlobalCheckpointer,
+                )
+
+                tier = GlobalCheckpointer(orbax_dir)
+                try:
+                    return tier.restore()
+                finally:
+                    tier.close()
             tier = self._orbax_tier()
             if tier is not None:
                 return tier.restore()
@@ -124,14 +137,25 @@ class Checkpointer:
         ok = self._engine.wait_async(timeout=timeout)
         if self._orbax is not None:
             remaining = max(0.1, timeout - (_time.monotonic() - start))
-            t = threading.Thread(target=self._orbax.wait, daemon=True)
-            t.start()
-            t.join(timeout=remaining)
-            ok = ok and not t.is_alive()
+            if self._orbax_waiter is None or (
+                not self._orbax_waiter.is_alive()
+            ):
+                self._orbax_waiter = threading.Thread(
+                    target=self._orbax.wait, daemon=True
+                )
+                self._orbax_waiter.start()
+            self._orbax_waiter.join(timeout=remaining)
+            timed_out = self._orbax_waiter.is_alive()
+            self._orbax_hung = timed_out
+            ok = ok and not timed_out
         return ok
 
     def close(self):
-        if self._orbax is not None:
+        if self._orbax is not None and not self._orbax_hung:
+            # a wait() that already timed out means the store is hung;
+            # re-entering the unbounded wait here would blow through
+            # the preemption grace period the caller bounded
             self._orbax.wait()
+        if self._orbax is not None and not self._orbax_hung:
             self._orbax.close()
         self._engine.close()

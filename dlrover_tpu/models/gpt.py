@@ -149,20 +149,26 @@ def cached_decode_attention(
     """Chunked decode attention against a KV cache.
 
     ``q``: [b, s_new, h, d] (prompt prefill or a 1-token step);
-    ``k_cache``/``v_cache``: [b, max_len, h, d] with this chunk
-    already written; ``q_pos``: [s_new] absolute positions.  Masks
-    both causality inside the chunk and the unfilled cache tail.
+    ``k_cache``/``v_cache``: [b, max_len, kv_heads, d] with this
+    chunk already written (``kv_heads`` may divide ``h`` — GQA);
+    ``q_pos``: [s_new] absolute positions.  Masks both causality
+    inside the chunk and the unfilled cache tail.
     """
-    scale = q.shape[-1] ** -0.5
+    b, s, h, d = q.shape
+    kvh = k_cache.shape[2]
+    group = h // kvh
+    qg = q.reshape(b, s, kvh, group, d)
+    scale = d**-0.5
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k_cache,
+        "bqkgd,bmkd->bkgqm", qg, k_cache,
         preferred_element_type=jnp.float32,
     ) * scale
     k_pos = jnp.arange(k_cache.shape[1])
     mask = k_pos[None, :] <= q_pos[:, None]  # [s_new, max_len]
-    logits = jnp.where(mask[None, None], logits, -1e30)
+    logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
+    return out.reshape(b, s, h, d)
 
 
 class Attention(nn.Module):

@@ -95,34 +95,6 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def gqa_decode_attention(
-    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-    q_pos: jax.Array, dtype=jnp.bfloat16,
-) -> jax.Array:
-    """Chunked decode attention against a kv-head-granular cache.
-
-    ``q``: [b, s_new, num_heads, d]; ``k_cache``/``v_cache``:
-    [b, max_len, num_kv_heads, d].  Query heads are folded into
-    (kv_head, group) so the cache is never expanded (the whole point
-    of GQA); masks causality + the unfilled cache tail.
-    """
-    b, s, h, d = q.shape
-    kvh = k_cache.shape[2]
-    group = h // kvh
-    qg = q.reshape(b, s, kvh, group, d)
-    scale = d**-0.5
-    logits = jnp.einsum(
-        "bqkgd,bmkd->bkgqm", qg, k_cache,
-        preferred_element_type=jnp.float32,
-    ) * scale
-    k_pos = jnp.arange(k_cache.shape[1])
-    mask = k_pos[None, :] <= q_pos[:, None]  # [s_new, max_len]
-    logits = jnp.where(mask[None, None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
-    out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
-    return out.reshape(b, s, h, d)
-
-
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -171,10 +143,14 @@ class LlamaAttention(nn.Module):
                 cv.value, v, (0, pos, 0, 0)
             )
             idx.value = pos + s
-            # GQA-aware: the cache stays at kv-head granularity; q is
-            # folded to [b, s, kv_heads, group, d] instead of
+            # GQA-aware shared helper: the cache stays at kv-head
+            # granularity; q folds into (kv_head, group) instead of
             # expanding the whole cache every decode step
-            out = gqa_decode_attention(
+            from dlrover_tpu.models.gpt import (
+                cached_decode_attention,
+            )
+
+            out = cached_decode_attention(
                 q, ck.value, cv.value, positions, dtype=cfg.dtype
             )
         else:

@@ -14,6 +14,15 @@ next microbatch's compute.
 
 Differentiable end-to-end (scan + ppermute transpose = reverse
 pipeline for the backward pass).
+
+Memory model: like GPipe, autodiff stores each scan step's residuals,
+so activation memory grows with the microbatch count; the JAX answer
+is rematerialization — the model's ``remat`` knob wraps the stage
+body (``PipelinedGPT`` does this), recomputing activations in the
+backward pass so peak memory is one microbatch per stage.  An
+explicit 1F1B schedule (hand-written backward interleaving) would
+shave the recompute cost and is noted as a future optimization; on
+TPU the remat+GPipe combination is the established baseline.
 """
 
 from typing import Callable

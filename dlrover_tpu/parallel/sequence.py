@@ -173,10 +173,22 @@ def ring_attention(
         def step(carry, step_idx):
             acc, m, l, k_cur, v_cur = carry
             src = (idx - step_idx) % sp  # whose shard we now hold
-            acc2, m2, l2 = _block_partials(
-                q, k_cur, v_cur, q_off, src * s_loc, scale, causal
+
+            def block(q, k_cur, v_cur, acc, m, l):
+                acc2, m2, l2 = _block_partials(
+                    q, k_cur, v_cur, q_off, src * s_loc, scale,
+                    causal,
+                )
+                return _merge(acc, m, l, acc2, m2, l2)
+
+            # remat per ring step: without it autodiff stores every
+            # step's [s_loc, s_loc] logits (sp blocks alive at once in
+            # the backward), capping the reachable context length;
+            # recomputing one block at a time keeps peak memory at a
+            # single block
+            acc, m, l = jax.checkpoint(block)(
+                q, k_cur, v_cur, acc, m, l
             )
-            acc, m, l = _merge(acc, m, l, acc2, m2, l2)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
             return (acc, m, l, k_nxt, v_nxt), None

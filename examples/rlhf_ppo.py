@@ -1,0 +1,74 @@
+"""RLHF PPO on the four-role engine (toy reward).
+
+    python examples/rlhf_ppo.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.accel import Strategy
+from dlrover_tpu.models.gpt import GPT, GPTConfig
+from dlrover_tpu.rl.model_engine import (
+    ModelRole,
+    RLModelEngine,
+    RoleSpec,
+)
+from dlrover_tpu.rl.rollout import (
+    make_actor_loss,
+    make_critic_loss,
+    ppo_iteration,
+    sample_rollout_batch,
+)
+
+PROMPT_LEN, MAX_NEW = 8, 16
+
+
+def main():
+    cfg = GPTConfig.tiny(max_seq_len=64, vocab_size=64)
+    actor = GPT(cfg)
+    critic = GPT(
+        GPTConfig.tiny(max_seq_len=64, vocab_size=64, head="value")
+    )
+    ref_params = actor.init_params(jax.random.PRNGKey(1))
+
+    prompts = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (16, PROMPT_LEN), dtype=np.int32
+    ))
+    dp = Strategy(opts=[("parallel_mode", {}), ("amp_native", {})])
+    engine = RLModelEngine(
+        sample_rollout_batch(prompts, MAX_NEW),
+        {
+            ModelRole.ACTOR: RoleSpec(
+                model=actor,
+                loss_fn=make_actor_loss(actor, PROMPT_LEN),
+                optim_factory=lambda: optax.adam(5e-3),
+                strategy=dp,
+            ),
+            ModelRole.CRITIC: RoleSpec(
+                model=critic,
+                loss_fn=make_critic_loss(critic, PROMPT_LEN),
+                optim_factory=lambda: optax.adam(1e-3),
+                strategy=dp,
+            ),
+            ModelRole.REF: RoleSpec(model=actor, params=ref_params),
+        },
+    ).build()
+
+    def reward_fn(sequences):  # favor low token ids
+        resp = sequences[:, PROMPT_LEN:]
+        return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+    rng = jax.random.PRNGKey(2)
+    for it in range(20):
+        rng, sub = jax.random.split(rng)
+        metrics = ppo_iteration(
+            engine, prompts, sub, max_new_tokens=MAX_NEW,
+            kl_coef=0.02, reward_fn=reward_fn,
+        )
+        print(f"iter {it}: {metrics}")
+
+
+if __name__ == "__main__":
+    main()

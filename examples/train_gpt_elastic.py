@@ -1,0 +1,107 @@
+"""Elastic GPT-2 pretraining with flash checkpoints.
+
+Launch (single host, 4 chips):
+
+    tpurun --nproc_per_node=1 --max_restarts=3 \
+        examples/train_gpt_elastic.py
+
+Multi-host: run the same command on every host with
+DLROVER_MASTER_ADDR pointing at the rank-0 host (or let the k8s
+operator + ScalePlan machinery place the pods).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.accel import Strategy, auto_accelerate
+from dlrover_tpu.checkpoint.checkpointer import (
+    Checkpointer,
+    StorageType,
+)
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticTrainer,
+    init_jax_distributed,
+)
+
+SEQ, GLOBAL_BATCH, STEPS = 1024, 32, 1000
+
+
+def batches(vocab, rng):
+    while True:
+        data = rng.integers(
+            0, vocab, (GLOBAL_BATCH, SEQ + 1), dtype=np.int32
+        )
+        yield {
+            "x": jnp.asarray(data[:, :-1]),
+            "y": jnp.asarray(data[:, 1:]),
+        }
+
+
+def main():
+    init_jax_distributed()  # no-op single-process; agent-driven multi
+
+    cfg = GPTConfig.gpt2_small(
+        max_seq_len=SEQ, attention_impl="flash"
+    )
+    model = GPT(cfg)
+
+    def loss_fn(params, batch, model=model):
+        logits = model.apply({"params": params}, batch["x"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    rng = np.random.default_rng(0)
+    data = batches(cfg.vocab_size, rng)
+    sample = next(data)
+
+    # semi-auto: fsdp + bf16 + remat; drop strategy= for the full
+    # search (mesh factorizations ranked by dry runs)
+    result = auto_accelerate(
+        model, lambda: optax.adamw(3e-4, weight_decay=0.1),
+        loss_fn, sample,
+        strategy=Strategy(opts=[
+            ("fsdp", {}), ("amp_native", {}), ("checkpoint", {}),
+        ]),
+    )
+
+    trainer = ElasticTrainer(
+        global_batch_size=GLOBAL_BATCH,
+        micro_batch_size=GLOBAL_BATCH,
+        dp_size=max(1, result.mesh.shape["data"]),
+    )
+    ckpt = Checkpointer(
+        "/tmp/gpt_ckpt", orbax_dir="/tmp/gpt_ckpt_durable",
+        orbax_every=10,
+    )
+    start, restored = ckpt.load_checkpoint()
+    state = result.state
+    if start is not None:
+        state = jax.tree.map(
+            lambda t, r: jax.device_put(
+                jnp.asarray(r), t.sharding
+            ) if hasattr(t, "sharding") else r,
+            state, restored,
+        )
+        trainer.global_step = start
+
+    for step in range(trainer.global_step, STEPS):
+        state, metrics = result.train_step(
+            state, result.place_batch(next(data))
+        )
+        trainer.report_step(metrics)
+        if step % 10 == 0:
+            # ~50ms stall: on-device snapshot, async persist
+            ckpt.save_checkpoint(
+                step,
+                {"params": state.params,
+                 "opt_state": state.opt_state},
+                storage_type=StorageType.DISK,
+            )
+    ckpt.wait()
+    ckpt.close()
+
+
+if __name__ == "__main__":
+    main()
