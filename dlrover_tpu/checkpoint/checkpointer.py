@@ -133,19 +133,30 @@ class Checkpointer:
         import threading
         import time as _time
 
-        start = _time.monotonic()
+        deadline = _time.monotonic() + timeout
         ok = self._engine.wait_async(timeout=timeout)
         if self._orbax is not None:
-            remaining = max(0.1, timeout - (_time.monotonic() - start))
-            if self._orbax_waiter is None or (
-                not self._orbax_waiter.is_alive()
-            ):
-                self._orbax_waiter = threading.Thread(
-                    target=self._orbax.wait, daemon=True
+            # drain any stale waiter first: it entered orbax's wait
+            # BEFORE saves issued since, so only a FRESH wait that
+            # completes counts as success (a stale thread finishing
+            # in a race gap must not)
+            stale = self._orbax_waiter
+            if stale is not None and stale.is_alive():
+                stale.join(
+                    timeout=max(0.05, deadline - _time.monotonic())
                 )
-                self._orbax_waiter.start()
-            self._orbax_waiter.join(timeout=remaining)
-            timed_out = self._orbax_waiter.is_alive()
+                if stale.is_alive():
+                    self._orbax_hung = True
+                    return False
+            fresh = threading.Thread(
+                target=self._orbax.wait, daemon=True
+            )
+            fresh.start()
+            fresh.join(
+                timeout=max(0.05, deadline - _time.monotonic())
+            )
+            timed_out = fresh.is_alive()
+            self._orbax_waiter = fresh if timed_out else None
             self._orbax_hung = timed_out
             ok = ok and not timed_out
         return ok
@@ -156,6 +167,48 @@ class Checkpointer:
             # re-entering the unbounded wait here would blow through
             # the preemption grace period the caller bounded
             self._orbax.wait()
-        if self._orbax is not None and not self._orbax_hung:
             self._orbax.close()
         self._engine.close()
+
+
+def restore_to_template(template, restored, device_put: bool = True):
+    """Rebuild a restored checkpoint (plain nested dicts — the shm
+    format flattens pytrees to string paths) onto ``template``'s tree
+    structure: optax tuples/NamedTuples, flax containers, dataclasses
+    all come back typed, each leaf ``device_put`` to the template
+    leaf's sharding when it has one.
+
+    The reference never needed this (torch state dicts are already
+    plain dicts); JAX optimizer states are structured pytrees, so the
+    restructure lives here next to the engine.
+
+    Prefer ``load_checkpoint(target_state=...)`` when you hold a
+    template with shardings — it additionally re-assembles shards
+    after a topology change; this helper covers the replicated
+    plain-``load_checkpoint()`` flow.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.checkpoint.shm_handler import _path_str
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tleaf in flat:
+        node = restored
+        for p in path:
+            key = _path_str(p)
+            if isinstance(node, dict) and key in node:
+                node = node[key]
+            else:
+                raise KeyError(
+                    f"checkpoint is missing leaf "
+                    f"'{'/'.join(_path_str(q) for q in path)}'"
+                )
+        val = node
+        if device_put and hasattr(tleaf, "sharding"):
+            val = jax.device_put(
+                jnp.asarray(val), tleaf.sharding
+            )
+        leaves.append(val)
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -16,7 +16,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from dlrover_tpu.models.gpt import get_attention_fn
+from dlrover_tpu.models.gpt import (
+    cached_decode_attention,
+    get_attention_fn,
+)
 
 
 @dataclass(frozen=True)
@@ -146,10 +149,6 @@ class LlamaAttention(nn.Module):
             # GQA-aware shared helper: the cache stays at kv-head
             # granularity; q folds into (kv_head, group) instead of
             # expanding the whole cache every decode step
-            from dlrover_tpu.models.gpt import (
-                cached_decode_attention,
-            )
-
             out = cached_decode_attention(
                 q, ck.value, cv.value, positions, dtype=cfg.dtype
             )

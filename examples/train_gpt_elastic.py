@@ -75,14 +75,20 @@ def main():
         "/tmp/gpt_ckpt", orbax_dir="/tmp/gpt_ckpt_durable",
         orbax_every=10,
     )
-    start, restored = ckpt.load_checkpoint()
+    # target-state restore: leaves come back typed AND re-sharded
+    # onto this run's placement even if the mesh shape changed
     state = result.state
+    start, restored = ckpt.load_checkpoint(target_state={
+        "params": state.params, "opt_state": state.opt_state,
+    })
     if start is not None:
-        state = jax.tree.map(
-            lambda t, r: jax.device_put(
-                jnp.asarray(r), t.sharding
-            ) if hasattr(t, "sharding") else r,
-            state, restored,
+        import dataclasses
+
+        state = dataclasses.replace(
+            state,
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            step=jnp.asarray(start, jnp.int32),
         )
         trainer.global_step = start
 

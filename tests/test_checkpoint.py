@@ -6,6 +6,7 @@ reference's test pattern (test_ckpt_saver.py)."""
 import os
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -273,3 +274,39 @@ def test_fastcopy_gil_release_and_correctness():
     assert len(during) >= max(3, int(elapsed / 0.01)), (
         len(during), elapsed
     )
+
+
+def test_restore_to_template_rebuilds_optax_state(saver, tmp_path):
+    """Flash restores come back as plain dicts; restore_to_template
+    rebuilds optax tuples/NamedTuples and re-places shardings."""
+    import optax
+
+    from dlrover_tpu.checkpoint.checkpointer import (
+        restore_to_template,
+    )
+
+    params = {"w": jnp.arange(6.0).reshape(2, 3)}
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    engine = CheckpointEngine(
+        str(tmp_path), replicated=True, local_rank=0, global_rank=0,
+        world_size=1,
+    )
+    assert engine.save_to_memory(
+        1, {"params": params, "opt_state": opt_state}
+    )
+    step, restored = engine.load()
+    assert step == 1
+    rebuilt = restore_to_template(opt_state, restored["opt_state"])
+    # same tree structure as the live optax state
+    assert jax.tree_util.tree_structure(
+        rebuilt
+    ) == jax.tree_util.tree_structure(opt_state)
+    # usable in an update without errors
+    g = {"w": jnp.ones((2, 3))}
+    updates, _ = opt.update(g, rebuilt, params)
+    assert jax.tree_util.tree_leaves(updates)
+    # missing leaves fail loudly
+    with pytest.raises(KeyError):
+        restore_to_template(opt_state, {"nope": {}})
+    engine.close()
