@@ -83,12 +83,20 @@ def analyse(context) -> AnalysisResult:
 def fits_in_hbm(
     analysis: AnalysisResult, fsdp_size: int, tensor_size: int,
     remat: bool, activation_factor: float = 4.0,
+    seq_shards: int = 1,
 ) -> bool:
     """Rough memory feasibility check for a candidate plan (the role
-    of the reference's dryrun memory profiling, cheaper)."""
+    of the reference's dryrun memory profiling, cheaper).
+    ``seq_shards``: ring/Ulysses sequence parallelism divides the
+    activation footprint (params stay whole per device) — without
+    this credit every SP candidate would be pruned in exactly the
+    long-sequence regime SP exists for."""
     shard = max(1, fsdp_size * tensor_size)
     state = analysis.model_state_bytes() / shard
-    act = analysis.batch_bytes * activation_factor
+    act = (
+        analysis.batch_bytes * activation_factor
+        / max(1, seq_shards)
+    )
     if remat:
         act *= 0.35
     headroom = 0.9 * analysis.per_device_hbm

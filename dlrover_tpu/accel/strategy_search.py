@@ -30,6 +30,8 @@ class Candidate:
     data: int = 1
     fsdp: int = 1
     tensor: int = 1
+    sequence: int = 1
+    expert: int = 1
     remat: bool = False
     grad_accum: int = 1
     step_time_s: Optional[float] = None
@@ -38,6 +40,8 @@ class Candidate:
         return {
             "log_fsdp": math.log2(self.fsdp),
             "log_tensor": math.log2(self.tensor),
+            "log_seq": math.log2(self.sequence),
+            "log_expert": math.log2(self.expert),
             "remat": float(self.remat),
             "log_accum": math.log2(self.grad_accum),
         }
@@ -45,6 +49,8 @@ class Candidate:
     def describe(self) -> str:
         return (
             f"data{self.data}xfsdp{self.fsdp}xtp{self.tensor}"
+            f"{f'xsp{self.sequence}' if self.sequence > 1 else ''}"
+            f"{f'xep{self.expert}' if self.expert > 1 else ''}"
             f"{'+remat' if self.remat else ''}"
             f"{f'+ga{self.grad_accum}' if self.grad_accum > 1 else ''}"
         )
@@ -66,17 +72,23 @@ def _divisors(n: int) -> List[int]:
 
 def _build_strategy(
     data: int, fsdp: int, tensor: int, remat: bool, grad_accum: int,
+    sequence: int = 1, expert: int = 1,
 ) -> Strategy:
     opts: List[Tuple[str, Dict]] = []
-    if tensor > 1:
+    if tensor > 1 or expert > 1 or (fsdp > 1 and sequence > 1):
         opts.append((
             "mixed_parallel",
-            {"tensor": tensor, "fsdp": fsdp, "data": -1},
+            {"tensor": tensor, "fsdp": fsdp, "expert": expert,
+             "data": -1},
         ))
     elif fsdp > 1:
         opts.append(("fsdp", {"size": fsdp}))
     else:
         opts.append(("parallel_mode", {}))
+    if sequence > 1:
+        opts.append((
+            "sequence_parallel", {"size": sequence, "mode": "ring"},
+        ))
     opts.append(("amp_native", {}))
     if remat:
         opts.append(("checkpoint", {}))
@@ -88,33 +100,60 @@ def generate_candidates(
     num_devices: int,
     grad_accums: Tuple[int, ...] = (1, 2),
     max_tensor: int = 8,
+    long_seq_threshold: int = 8192,
 ) -> List[Candidate]:
     """Combination generation pruned by the memory model (reference:
-    combination_sg.py)."""
+    combination_sg.py).  Model-aware axes: MoE configs get
+    expert-parallel variants, long sequences get ring
+    sequence-parallel variants (the tensor slot of each factorization
+    is repurposed — both shard the same "model" dimension budget)."""
     analysis = analyse(context)
     batch = max(1, analysis.batch_size)
+    model_cfg = getattr(context.model, "config", None)
+    is_moe = bool(getattr(model_cfg, "moe_experts", 0))
+    long_seq = analysis.seq_len >= long_seq_threshold
     cands: List[Candidate] = []
     seen = set()
     for data, fsdp, tensor in mesh_factorizations(num_devices):
         if tensor > max_tensor:
             continue
-        for remat in (False, True):
-            if not fits_in_hbm(analysis, fsdp, tensor, remat):
-                continue
-            for ga in grad_accums:
-                if batch % (ga * max(1, data * fsdp)):
+        # the third factor is a "model-dim shard" budget: try it as
+        # tensor parallel, and — when the model calls for it — as
+        # expert or ring-sequence parallel instead
+        variants = [(tensor, 1, 1)]
+        num_experts = int(getattr(model_cfg, "moe_experts", 0) or 0)
+        if (
+            tensor > 1 and is_moe
+            and num_experts % tensor == 0  # expert dim must shard
+        ):
+            variants.append((1, 1, tensor))   # expert
+        if (
+            tensor > 1 and long_seq
+            and analysis.seq_len % tensor == 0
+        ):
+            variants.append((1, tensor, 1))   # ring sp
+        for tp, sp, ep in variants:
+            for remat in (False, True):
+                if not fits_in_hbm(
+                    analysis, fsdp, tp, remat, seq_shards=sp
+                ):
                     continue
-                key = (data, fsdp, tensor, remat, ga)
-                if key in seen:
-                    continue
-                seen.add(key)
-                cands.append(Candidate(
-                    strategy=_build_strategy(
-                        data, fsdp, tensor, remat, ga
-                    ),
-                    data=data, fsdp=fsdp, tensor=tensor,
-                    remat=remat, grad_accum=ga,
-                ))
+                for ga in grad_accums:
+                    if batch % (ga * max(1, data * fsdp)):
+                        continue
+                    key = (data, fsdp, tp, sp, ep, remat, ga)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    cands.append(Candidate(
+                        strategy=_build_strategy(
+                            data, fsdp, tp, remat, ga,
+                            sequence=sp, expert=ep,
+                        ),
+                        data=data, fsdp=fsdp, tensor=tp,
+                        sequence=sp, expert=ep,
+                        remat=remat, grad_accum=ga,
+                    ))
     if not cands:
         # nothing fits the model: fall back to the most
         # memory-frugal plan and let the dry run surface the OOM
@@ -179,6 +218,8 @@ def search_strategy(
         params = [
             Parameter("log_fsdp", 0.0, math.log2(num_devices)),
             Parameter("log_tensor", 0.0, math.log2(num_devices)),
+            Parameter("log_seq", 0.0, math.log2(num_devices)),
+            Parameter("log_expert", 0.0, math.log2(num_devices)),
             Parameter("remat", 0.0, 1.0),
             Parameter("log_accum", 0.0, math.log2(max(grad_accums))),
         ]

@@ -134,7 +134,13 @@ class Checkpointer:
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        ok = self._engine.wait_async(timeout=timeout)
+        # when a durable tier exists, the shm drain may not consume
+        # the whole budget — orbax needs a real share, not a 50 ms
+        # floor probe that would falsely mark a healthy store hung
+        engine_budget = (
+            timeout if self._orbax is None else max(0.1, timeout * 0.7)
+        )
+        ok = self._engine.wait_async(timeout=engine_budget)
         if self._orbax is not None:
             # drain any stale waiter first: it entered orbax's wait
             # BEFORE saves issued since, so only a FRESH wait that

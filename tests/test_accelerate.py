@@ -262,3 +262,39 @@ def test_tp_rules_registry_resolution():
         from dlrover_tpu.parallel.registry import unregister_tp_rules
 
         unregister_tp_rules("Bert")
+
+
+def test_generate_candidates_model_aware_axes():
+    """MoE models get expert-parallel variants; long sequences get
+    ring-SP variants (the search explores every mesh axis the model
+    can use)."""
+    from dlrover_tpu.accel.strategy_search import generate_candidates
+    from dlrover_tpu.models.gpt import GPT, GPTConfig
+
+    moe_cfg = GPTConfig.tiny(moe_experts=2, max_seq_len=64)
+    model = GPT(moe_cfg)
+    data = np.random.default_rng(0).integers(
+        0, moe_cfg.vocab_size, (8, 33), dtype=np.int32
+    )
+    batch = {
+        "x": jnp.asarray(data[:, :-1]),
+        "y": jnp.asarray(data[:, 1:]),
+    }
+    ctx = ModelContext(
+        model=model, optim_factory=lambda: optax.sgd(0.1),
+        loss_fn=lambda p, b: 0.0, sample_batch=batch,
+    )
+    cands = generate_candidates(ctx, 8, grad_accums=(1,))
+    assert any(c.expert > 1 for c in cands), [
+        c.describe() for c in cands
+    ]
+    # long-sequence model -> ring SP variants appear
+    cands2 = generate_candidates(
+        ctx, 8, grad_accums=(1,), long_seq_threshold=16
+    )
+    assert any(c.sequence > 1 for c in cands2)
+    sp_cand = next(c for c in cands2 if c.sequence > 1)
+    assert ("sequence_parallel", {"size": sp_cand.sequence,
+                                  "mode": "ring"}) in (
+        sp_cand.strategy.opts
+    )

@@ -88,7 +88,11 @@ def test_llama2_7b_fsdp_train_step_lowers():
     # the SPMD program exists and the state is genuinely sharded
     text = lowered.as_text()
     assert "sharding" in text
-    # per-device param bytes after fsdp8: ~7B * 4 / 8 = ~3.4 GB
     leaf = abstract_state.params["block_0"]["attn"]["q_proj"]["kernel"]
     spec = rules.spec_for("block_0/attn/q_proj/kernel")
     assert spec == P("fsdp", None)
+    # per-device share of the fp32 state after fsdp8 fits a v5p chip:
+    # (params + adam mu/nu) / 8
+    state_bytes = 3 * n_params * 4
+    assert state_bytes / 8 < 95e9 / 8  # ~10 GB/device of 95 GB HBM
+    assert leaf.shape[0] % 8 == 0  # dim 0 divides over the fsdp axis
