@@ -339,6 +339,121 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     return f_sync / max(f_flash, 1e-9)
 
 
+# One elastic train script for the recovery bench AND the e2e tests
+# (tests/test_e2e_elastic.py imports it) — a single source of truth
+# for the crash/restore flow.  argv: ckpt_dir crash_flag
+# restored_flag crash_mode(exit|kill)
+ELASTIC_TRAIN_SCRIPT = r'''
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticTrainer, TrainState, make_train_step,
+)
+
+ckpt_dir, crash_flag, restored_flag, crash_mode = sys.argv[1:5]
+
+cfg = GPTConfig.tiny()
+model = GPT(cfg)
+optimizer = optax.adam(1e-3)
+
+def loss_fn(p, batch):
+    logits = model.apply({"params": p}, batch["x"])
+    return cross_entropy_loss(logits, batch["y"])
+
+step_fn = make_train_step(loss_fn, optimizer)
+ckpt = Checkpointer(ckpt_dir)
+start_step, restored = ckpt.load_checkpoint()
+if start_step is None:
+    params = model.init_params(jax.random.PRNGKey(0))
+    start_step = 0
+else:
+    params = jax.tree.map(jnp.asarray, restored["params"])
+state = TrainState.create(params, optimizer)
+
+trainer = ElasticTrainer(global_batch_size=8, micro_batch_size=8,
+                         dp_size=1)
+trainer.global_step = start_step
+rng = np.random.default_rng(0)
+data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
+batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
+
+for i in range(start_step, 5):
+    state, metrics = step_fn(state, batch)
+    trainer.report_step(metrics)
+    ckpt.save_checkpoint(
+        trainer.global_step,
+        {"params": state.params, "trainer": trainer.state_dict()},
+        storage_type=StorageType.MEMORY,
+    )
+    if start_step > 0 and not os.path.exists(restored_flag):
+        open(restored_flag, "w").close()  # first step after restore
+    if trainer.global_step == 3 and not os.path.exists(crash_flag):
+        open(crash_flag, "w").close()
+        if crash_mode == "kill":
+            os.kill(os.getpid(), 9)  # hard kill AFTER the shm save
+        sys.exit(17)  # simulated crash AFTER the shm save
+
+ckpt.save_checkpoint(
+    5, {"params": state.params, "trainer": trainer.state_dict()},
+    storage_type=StorageType.DISK,
+)
+# wait for the agent-side async persist to commit before exiting
+ckpt.wait()
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+deadline = time.time() + 60
+while time.time() < deadline and not os.path.exists(tracker):
+    time.sleep(0.2)
+assert os.path.exists(tracker), "checkpoint commit did not land"
+ckpt.close()
+'''
+
+
+def bench_elastic_recovery(results: dict, workdir: str):
+    """Crash -> agent restart -> shm restore -> first new step, on the
+    CPU mesh via the real tpurun supervision path (the north-star
+    story: fast recovery is what goodput under churn is made of)."""
+    recovery_dir = os.path.join(workdir, "recovery")
+    os.makedirs(recovery_dir, exist_ok=True)
+    script = os.path.join(recovery_dir, "train.py")
+    with open(script, "w") as f:
+        f.write(ELASTIC_TRAIN_SCRIPT)
+    ckpt_dir = os.path.join(recovery_dir, "ckpt")
+    crash_flag = os.path.join(recovery_dir, "crashed")
+    restored_flag = os.path.join(recovery_dir, "restored")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.getcwd(),
+        DLROVER_SHARED_DIR=os.path.join(recovery_dir, "sock"),
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--nproc_per_node=1", "--max_restarts=2",
+            "--monitor_interval=0.3",
+            script, ckpt_dir, crash_flag, restored_flag, "kill",
+        ],
+        env=env, cwd=os.getcwd(), capture_output=True, text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert os.path.exists(crash_flag) and os.path.exists(restored_flag)
+    recovery_s = os.path.getmtime(restored_flag) - os.path.getmtime(
+        crash_flag
+    )
+    results["elastic_recovery"] = {
+        "recovery_s": round(recovery_s, 2),
+        "flow": "SIGKILL -> agent restart -> shm restore -> next step",
+    }
+
+
 def main() -> int:
     workdir = tempfile.mkdtemp(prefix="dlrover_bench_")
     os.environ.setdefault(
@@ -347,19 +462,35 @@ def main() -> int:
     import jax
 
     results = {"platform": jax.devices()[0].platform}
-    try:
-        bench_train_step(jax, results)
-    except Exception as e:  # noqa: BLE001
-        results["train_step_error"] = f"{type(e).__name__}: {e}"
-    try:
-        bench_attention_kernel(jax, results)
-    except Exception as e:  # noqa: BLE001
-        results["attention_kernel_error"] = f"{type(e).__name__}: {e}"
+    # the tunnel backend occasionally drops a connection mid-compile;
+    # one retry distinguishes transient infra from real failures
+    for attempt in (1, 2):
+        try:
+            bench_train_step(jax, results)
+            results.pop("train_step_error", None)
+            break
+        except Exception as e:  # noqa: BLE001
+            results["train_step_error"] = f"{type(e).__name__}: {e}"
+            time.sleep(5)
+    for attempt in (1, 2):
+        try:
+            bench_attention_kernel(jax, results)
+            results.pop("attention_kernel_error", None)
+            break
+        except Exception as e:  # noqa: BLE001
+            results["attention_kernel_error"] = (
+                f"{type(e).__name__}: {e}"
+            )
+            time.sleep(5)
     speedup = 0.0
     try:
         speedup = bench_flash_ckpt(jax, results, workdir)
     except Exception as e:  # noqa: BLE001
         results["flash_ckpt_error"] = f"{type(e).__name__}: {e}"
+    try:
+        bench_elastic_recovery(results, workdir)
+    except Exception as e:  # noqa: BLE001
+        results["elastic_recovery_error"] = f"{type(e).__name__}: {e}"
     shutil.rmtree(workdir, ignore_errors=True)
 
     print(

@@ -83,16 +83,23 @@ def analyse(context) -> AnalysisResult:
 def fits_in_hbm(
     analysis: AnalysisResult, fsdp_size: int, tensor_size: int,
     remat: bool, activation_factor: float = 4.0,
-    seq_shards: int = 1,
+    seq_shards: int = 1, expert_shards: int = 1,
+    expert_param_fraction: float = 0.5,
 ) -> bool:
     """Rough memory feasibility check for a candidate plan (the role
     of the reference's dryrun memory profiling, cheaper).
-    ``seq_shards``: ring/Ulysses sequence parallelism divides the
-    activation footprint (params stay whole per device) — without
-    this credit every SP candidate would be pruned in exactly the
-    long-sequence regime SP exists for."""
+
+    Axis credits — each parallelism must be charged what it actually
+    shards or the check prunes it in exactly the regime it exists
+    for: ``seq_shards`` (ring/Ulysses) divides activations;
+    ``expert_shards`` divides the expert slice of the state
+    (``expert_param_fraction``, conservatively half for a standard
+    MoE transformer where expert MLPs dominate)."""
     shard = max(1, fsdp_size * tensor_size)
     state = analysis.model_state_bytes() / shard
+    if expert_shards > 1:
+        f = expert_param_fraction
+        state = state * (1.0 - f + f / expert_shards)
     act = (
         analysis.batch_bytes * activation_factor
         / max(1, seq_shards)

@@ -135,7 +135,8 @@ def generate_candidates(
         for tp, sp, ep in variants:
             for remat in (False, True):
                 if not fits_in_hbm(
-                    analysis, fsdp, tp, remat, seq_shards=sp
+                    analysis, fsdp, tp, remat,
+                    seq_shards=sp, expert_shards=ep,
                 ):
                     continue
                 for ga in grad_accums:

@@ -55,6 +55,7 @@ class Checkpointer:
         self._orbax = None
         self._orbax_waiter = None
         self._orbax_hung = False
+        self._orbax_dirty = False
         self._storage_saves = 0
 
     def _orbax_tier(self):
@@ -89,6 +90,7 @@ class Checkpointer:
             # async inside orbax; jax.Array immutability makes the
             # concurrent snapshot safe
             self._orbax_tier().save(step, state_dict)
+            self._orbax_dirty = True
         return ok
 
     def load_checkpoint(
@@ -134,14 +136,18 @@ class Checkpointer:
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        # when a durable tier exists, the shm drain may not consume
-        # the whole budget — orbax needs a real share, not a 50 ms
-        # floor probe that would falsely mark a healthy store hung
+        # split the budget only when the durable tier actually has
+        # pending work — orbax then needs a real share, not a 50 ms
+        # floor probe that would falsely mark a healthy store hung;
+        # with nothing pending the shm drain keeps the whole budget
+        orbax_pending = self._orbax is not None and (
+            self._orbax_dirty or self._orbax_waiter is not None
+        )
         engine_budget = (
-            timeout if self._orbax is None else max(0.1, timeout * 0.7)
+            max(0.1, timeout * 0.7) if orbax_pending else timeout
         )
         ok = self._engine.wait_async(timeout=engine_budget)
-        if self._orbax is not None:
+        if orbax_pending:
             # drain any stale waiter first: it entered orbax's wait
             # BEFORE saves issued since, so only a FRESH wait that
             # completes counts as success (a stale thread finishing
@@ -164,6 +170,7 @@ class Checkpointer:
             timed_out = fresh.is_alive()
             self._orbax_waiter = fresh if timed_out else None
             self._orbax_hung = timed_out
+            self._orbax_dirty = timed_out
             ok = ok and not timed_out
         return ok
 

@@ -11,71 +11,7 @@ import pytest
 from dlrover_tpu import run as tpurun
 from dlrover_tpu.checkpoint.saver import read_last_checkpoint
 
-TRAIN_SCRIPT = '''
-import os, sys, time
-import jax
-jax.config.update("jax_platforms", "cpu")
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
-from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
-from dlrover_tpu.trainer.elastic_trainer import (
-    ElasticTrainer, TrainState, make_train_step,
-)
-
-ckpt_dir = sys.argv[1]
-crash_flag = sys.argv[2]
-
-cfg = GPTConfig.tiny()
-model = GPT(cfg)
-optimizer = optax.adam(1e-3)
-
-def loss_fn(p, batch):
-    logits = model.apply({"params": p}, batch["x"])
-    return cross_entropy_loss(logits, batch["y"])
-
-step_fn = make_train_step(loss_fn, optimizer)
-ckpt = Checkpointer(ckpt_dir)
-start_step, restored = ckpt.load_checkpoint()
-if start_step is None:
-    params = model.init_params(jax.random.PRNGKey(0))
-    start_step = 0
-else:
-    params = jax.tree.map(jnp.asarray, restored["params"])
-state = TrainState.create(params, optimizer)
-
-trainer = ElasticTrainer(global_batch_size=8, micro_batch_size=8,
-                         dp_size=1)
-trainer.global_step = start_step
-rng = np.random.default_rng(0)
-data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
-batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
-
-for i in range(start_step, 5):
-    state, metrics = step_fn(state, batch)
-    trainer.report_step(metrics)
-    ckpt.save_checkpoint(
-        trainer.global_step,
-        {"params": state.params, "trainer": trainer.state_dict()},
-        storage_type=StorageType.MEMORY,
-    )
-    if trainer.global_step == 3 and not os.path.exists(crash_flag):
-        open(crash_flag, "w").close()
-        sys.exit(17)  # simulated crash AFTER the shm save
-
-ckpt.save_checkpoint(
-    5, {"params": state.params, "trainer": trainer.state_dict()},
-    storage_type=StorageType.DISK,
-)
-# wait for the agent-side async persist to commit before exiting
-tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
-deadline = time.time() + 60
-while time.time() < deadline and not os.path.exists(tracker):
-    time.sleep(0.2)
-assert os.path.exists(tracker), "checkpoint commit did not land"
-'''
+from bench import ELASTIC_TRAIN_SCRIPT as TRAIN_SCRIPT
 
 
 def test_tpurun_crash_restart_restore(tmp_path, monkeypatch):
@@ -93,6 +29,8 @@ def test_tpurun_crash_restart_restore(tmp_path, monkeypatch):
             str(script),
             str(ckpt_dir),
             str(crash_flag),
+            str(tmp_path / "restored"),
+            "exit",
         ]
     )
     assert rc == 0
@@ -131,6 +69,8 @@ def test_goodput_accounting_through_crash(tmp_path, monkeypatch):
                 str(script),
                 str(tmp_path / "ckpt"),
                 str(tmp_path / "crashed"),
+                str(tmp_path / "restored"),
+                "exit",
             ]
         )
         assert rc == 0

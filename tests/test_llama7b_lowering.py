@@ -94,5 +94,5 @@ def test_llama2_7b_fsdp_train_step_lowers():
     # per-device share of the fp32 state after fsdp8 fits a v5p chip:
     # (params + adam mu/nu) / 8
     state_bytes = 3 * n_params * 4
-    assert state_bytes / 8 < 95e9 / 8  # ~10 GB/device of 95 GB HBM
+    assert state_bytes / 8 < 95e9  # per-device share fits a v5p chip
     assert leaf.shape[0] % 8 == 0  # dim 0 divides over the fsdp axis
