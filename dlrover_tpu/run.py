@@ -45,7 +45,16 @@ def parse_args(argv: Optional[List[str]] = None):
         "--nnodes", type=str, default="1",
         help="number of nodes, or MIN:MAX for elastic jobs",
     )
-    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument(
+        "--nproc_per_node", type=int, default=1,
+        help="training processes per node (0 = one per local "
+        "TPU-host process, i.e. auto)",
+    )
+    parser.add_argument(
+        "--auto-config", action="store_true", dest="auto_config",
+        help="derive nproc_per_node from the local accelerator "
+        "runtime (reference: dlrover-run --auto-config)",
+    )
     parser.add_argument("--node_rank", type=int, default=None)
     parser.add_argument("--max_restarts", type=int, default=3)
     parser.add_argument(
@@ -93,7 +102,25 @@ def _launch_local_master(max_nodes: int, port: int = 0) -> Tuple[
     raise RuntimeError("local master did not become reachable")
 
 
+def apply_auto_config(args):
+    """Fill nproc_per_node from the machine (reference:
+    ``dlrover-run --auto-config``, elastic_run.py:125): on TPU-VMs
+    one training PROCESS drives all local chips (SPMD), so
+    nproc_per_node is 1 per host runtime — auto-config exists to
+    keep CLI parity and to future-proof multi-runtime hosts."""
+    if not (args.auto_config or args.nproc_per_node <= 0):
+        return args
+    # one jax process owns every local chip; multi-process-per-host
+    # would fight over the runtime
+    args.nproc_per_node = 1
+    logger.info(
+        "auto-config: nproc_per_node=%s", args.nproc_per_node
+    )
+    return args
+
+
 def run(args) -> int:
+    args = apply_auto_config(args)
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     node_rank = (
         args.node_rank

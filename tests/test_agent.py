@@ -203,3 +203,17 @@ def test_starter_builds_tpurun_argv():
     assert "--node_rank" in argv and "2" in argv
     assert "--network-check" in argv
     assert argv[-3:] == ["train.py", "--lr", "0.1"]
+
+
+def test_tpurun_auto_config():
+    from dlrover_tpu.run import apply_auto_config, parse_args
+
+    args = parse_args(["--auto-config", "t.py"])
+    assert apply_auto_config(args).nproc_per_node == 1
+    args = parse_args(["--nproc_per_node", "0", "t.py"])
+    assert apply_auto_config(args).nproc_per_node == 1
+    args = parse_args(["--nproc_per_node", "2", "t.py"])
+    assert apply_auto_config(args).nproc_per_node == 2
+    # negative values are treated as auto, never zero workers
+    args = parse_args(["--nproc_per_node", "-1", "t.py"])
+    assert apply_auto_config(args).nproc_per_node == 1
