@@ -271,7 +271,9 @@ class AsyncCheckpointSaver:
             )
             return False
         try:
-            config, raw, meta = handler.read_raw()
+            # zero-copy: the shard lock is held until the write lands,
+            # so the storage stream reads straight from shm
+            config, raw, meta = handler.read_raw(copy=False)
             if config is None:
                 logger.warning(
                     "rank %s has no shm snapshot for step %s",
@@ -370,7 +372,10 @@ class AsyncCheckpointSaver:
             )
         except Exception:  # noqa: BLE001
             pass
-        self._executor.shutdown(wait=False)
+        # wait for in-flight persist threads: they may hold zero-copy
+        # memoryviews into the shm segments (read_raw(copy=False)) —
+        # closing the mmap under them would raise BufferError
+        self._executor.shutdown(wait=True)
         for h in self._shm_handlers:
             h.close()
         for lk in self._shm_locks:

@@ -257,11 +257,34 @@ class SharedMemoryHandler:
         cfg = self.get_checkpoint_config()
         return cfg is None or cfg.step <= 0
 
-    def _attach(self) -> Optional[PersistentSharedMemory]:
+    def _attach(
+        self, min_size: int = 0
+    ) -> Optional[PersistentSharedMemory]:
+        """Attach (cached) to the segment; when the trainer grew and
+        recreated it, a cached mapping points at the old unlinked
+        inode — re-attach rather than silently slicing a truncated,
+        stale snapshot (``min_size`` = bytes the caller needs)."""
         if self._shm is None:
             try:
                 self._shm = PersistentSharedMemory(name=self._shm_name)
             except FileNotFoundError:
+                return None
+        if min_size and self._shm.size < min_size:
+            try:
+                self._shm.close()
+            except BufferError:  # a reader still holds a view
+                pass
+            self._shm = None
+            try:
+                self._shm = PersistentSharedMemory(name=self._shm_name)
+            except FileNotFoundError:
+                return None
+            if self._shm.size < min_size:
+                logger.error(
+                    "shm segment %s is %d bytes but the snapshot "
+                    "metadata claims %d; refusing a truncated read",
+                    self._shm_name, self._shm.size, min_size,
+                )
                 return None
         return self._shm
 
@@ -278,7 +301,9 @@ class SharedMemoryHandler:
         if config.writing:
             logger.warning("shm snapshot is mid-write; refusing to load")
             return None, {}, {}
-        shm = self._attach()
+        shm = self._attach(
+            min_size=meta["scalar_offset"] + meta["scalar_nbytes"]
+        )
         if shm is None:
             return None, {}, {}
         flat: Dict[str, Any] = {}
@@ -309,18 +334,29 @@ class SharedMemoryHandler:
         flat = _assemble_flat(flat, metas)
         return config, _unflatten_to_nested(flat)
 
-    def read_raw(self) -> Tuple[Optional[CheckpointConfig], bytes, Dict]:
-        """Raw bytes + meta for the agent's persist path (no pytree
-        reconstruction, just shm -> storage streaming)."""
+    def read_raw(
+        self, copy: bool = True
+    ) -> Tuple[Optional[CheckpointConfig], Any, Dict]:
+        """Raw snapshot + meta for the agent's persist path (no pytree
+        reconstruction, just shm -> storage streaming).
+
+        ``copy=False`` returns a zero-copy memoryview into the shm
+        segment: the agent persists while HOLDING the shard lock, so
+        streaming straight from shm skips a whole-snapshot ``bytes()``
+        copy — which both doubles persist wall time and holds the GIL
+        for the copy on slow-memcpy hosts, starving the agent's event
+        loop/heartbeats.  The view is only valid under the lock."""
         meta = self._meta.get(default_if_absent=True)
         if not meta:
             return None, b"", {}
         config: CheckpointConfig = meta["config"]
-        shm = self._attach()
+        total = meta["scalar_offset"] + meta["scalar_nbytes"]
+        shm = self._attach(min_size=total)
         if shm is None or config.writing:
             return None, b"", {}
-        total = meta["scalar_offset"] + meta["scalar_nbytes"]
-        return config, bytes(shm.buf[:total]), meta
+        if copy:
+            return config, bytes(shm.buf[:total]), meta
+        return config, shm.buf[:total], meta
 
     def close(self):
         if self._shm is not None:
