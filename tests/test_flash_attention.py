@@ -103,3 +103,21 @@ def test_model_integration_flash_impl():
     np.testing.assert_allclose(
         np.asarray(lx), np.asarray(lf), atol=5e-2, rtol=5e-2
     )
+
+
+def test_flash_attention_head_dim_128():
+    """Llama-7B-class head_dim: kernel tiling must hold at d=128."""
+    q, k, v = _rand_qkv(b=1, s=256, h=2, d=128, dtype=jnp.bfloat16)
+    from dlrover_tpu.models.gpt import xla_causal_attention
+
+    ref = xla_causal_attention(q, k, v)
+    out = flash_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )
+    # backward also traces/runs at d=128
+    g = jax.grad(
+        lambda q: flash_attention(q, k, v).astype(jnp.float32).sum()
+    )(q)
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
