@@ -141,7 +141,59 @@ def build_from_plan(
     rebuilt_ctx = dataclasses.replace(context, model=model)
     params = rebuilt_ctx.init_params()
     optimizer = context.optimizer()
-    state = TrainState.create(params, optimizer)
+    # shardings are derived from the abstract state so the offload
+    # path can materialize moments straight into host DRAM below
+    abstract_state = jax.eval_shape(
+        lambda p: TrainState.create(p, optimizer), params
+    )
+    shardings = state_shardings(abstract_state, mesh, plan)
+    opt_dev_shardings = None
+    offload_opt = plan.offload_opt_state
+    if offload_opt and mesh.devices.flat[0].platform == "cpu":
+        # the CPU backend has no jit-time pinned_host placement
+        # (annotate_device_placement is unimplemented there) — keep
+        # the plan runnable for tests/dry-runs, states stay in HBM
+        logger.warning(
+            "offload_opt: host offload is TPU-only (cpu backend has "
+            "no pinned_host support under jit); running un-offloaded"
+        )
+        note = "offload_opt degraded to no-op on cpu"
+        if note not in plan.notes:
+            plan.notes.append(note)
+        offload_opt = False
+    if offload_opt:
+        # opt-state leaves (not scalars like step counts) are pinned
+        # to host DRAM between steps (reference: adam_offload.py);
+        # inside the step they stream host->HBM->host via explicit
+        # transfers with the concrete shardings (memory kinds are
+        # part of the array type, so the update math cannot consume
+        # host-space operands directly)
+        opt_dev_shardings = shardings.opt_state
+        host_opt = jax.tree.map(
+            lambda s, x: (
+                s.with_memory_kind("pinned_host")
+                if getattr(x, "ndim", 0) > 0
+                else s
+            ),
+            shardings.opt_state,
+            abstract_state.opt_state,
+        )
+        shardings = TrainState(
+            params=shardings.params, opt_state=host_opt,
+            step=shardings.step,
+        )
+        # init the moments directly into host memory: the full fp32
+        # state never exists in HBM, even transiently (the whole
+        # point on configs where params fit but params+moments don't)
+        opt_state = jax.jit(
+            optimizer.init, out_shardings=host_opt
+        )(params)
+        state = TrainState(
+            params=params, opt_state=opt_state,
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+    else:
+        state = TrainState.create(params, optimizer)
 
     loss_fn = context.loss_fn
 
@@ -184,9 +236,14 @@ def build_from_plan(
             loss, grads = jax.value_and_grad(wrapped_loss)(
                 state.params, batch
             )
+        opt_state = state.opt_state
+        if opt_dev_shardings is not None:
+            opt_state = jax.device_put(opt_state, opt_dev_shardings)
         updates, new_opt = optimizer.update(
-            grads, state.opt_state, state.params
+            grads, opt_state, state.params
         )
+        if opt_dev_shardings is not None:
+            new_opt = jax.device_put(new_opt, shardings.opt_state)
         new_params = optax.apply_updates(state.params, updates)
         return (
             TrainState(
@@ -196,7 +253,6 @@ def build_from_plan(
             {"loss": loss, "grad_norm": optax.global_norm(grads)},
         )
 
-    shardings = state_shardings(state, mesh, plan)
     batch_sh = NamedSharding(
         mesh, batch_spec(plan.sequence_parallel != "none")
     )

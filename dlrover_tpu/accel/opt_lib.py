@@ -243,6 +243,24 @@ class PipelineParallelOpt(Optimization):
         return plan
 
 
+class OffloadOptStateOpt(Optimization):
+    """Host-offloaded optimizer states (reference: adam_offload.py
+    PartitionAdam).  ``build_from_plan`` marks the jitted step's
+    opt-state in/out shardings ``memory_kind='pinned_host'``, inits
+    the moments straight into host DRAM, and streams them
+    host->HBM->host around the optimizer update with explicit
+    sharded transfers.  (For hand-rolled loops outside
+    auto_accelerate, :func:`dlrover_tpu.optim.offload` wraps any
+    optax transform the same way.)"""
+
+    name = "offload_opt"
+
+    def apply(self, plan, config, context=None):
+        plan.offload_opt_state = True
+        plan.notes.append("optimizer states host-offloaded")
+        return plan
+
+
 class OptimizationLibrary:
     """Name -> Optimization registry (reference:
     optimization_library.py:18,40)."""
@@ -254,6 +272,7 @@ class OptimizationLibrary:
             TensorParallelOpt, SequenceParallelOpt, ExpertParallelOpt,
             MixedParallelOpt, AmpNativeOpt, HalfOpt, Fp8Opt,
             CheckpointOpt, ModuleReplaceOpt, PipelineParallelOpt,
+            OffloadOptStateOpt,
         ):
             self.register(cls())
 

@@ -31,6 +31,9 @@ class AccelPlan:
     grad_accum: int = 1
     pipeline_microbatches: int = 4
     fp8: bool = False
+    # optimizer states live in host DRAM between steps
+    # (reference: adam_offload.py; here via jax memory kinds)
+    offload_opt_state: bool = False
     notes: List[str] = field(default_factory=list)
 
     def effective_opt_rules(self) -> PartitionRules:

@@ -8,18 +8,25 @@ kernels in :mod:`dlrover_tpu.ops.quantization`.
 """
 
 from dlrover_tpu.optim.agd import agd
+from dlrover_tpu.optim.came import came, q_adafactor, q_came
 from dlrover_tpu.optim.local_sgd import (
     diloco_outer_step,
     init_diloco,
 )
 from dlrover_tpu.optim.low_bit import q_adamw
+from dlrover_tpu.optim.offload import adamw_offload, offload
 from dlrover_tpu.optim.wsam import sam_gradient, wsam
 
 __all__ = [
+    "adamw_offload",
     "agd",
+    "came",
     "diloco_outer_step",
     "init_diloco",
+    "offload",
+    "q_adafactor",
     "q_adamw",
+    "q_came",
     "sam_gradient",
     "wsam",
 ]

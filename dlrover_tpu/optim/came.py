@@ -1,0 +1,310 @@
+"""CAME and quantized Adafactor — the factored low-bit family.
+
+Reference behavior: ATorch's low-bit optimizer family
+(``atorch/optimizers/low_bit/optim/q_came.py``,
+``q_adafactor.py``): memory-efficient optimizers whose second moment
+is rank-1-factored (row/col means, Adafactor-style) and whose O(n)
+first moment is stored quantized.  CAME (Luo et al., 2023) adds a
+confidence-guided correction: a factored EMA of the squared residual
+``(update - m)^2`` rescales the momentum so unstable coordinates take
+smaller steps.
+
+TPU design: pure optax ``GradientTransformation``s — functional state
+pytrees that shard with the params under GSPMD (the factored row/col
+stats are tiny and replicate freely).  The quantized variants store
+the first moment as blockwise int8 via the Pallas kernels in
+:mod:`dlrover_tpu.ops.quantization`; the dequant -> math -> requant
+chain is elementwise and fuses into one HBM pass under XLA.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.ops.quantization import DEFAULT_BLOCK
+from dlrover_tpu.optim.low_bit import QMoment, _dequant, _quant
+
+
+def _rms(x):
+    return jnp.sqrt(jnp.mean(jnp.square(x)))
+
+
+def _factored(shape) -> bool:
+    return len(shape) >= 2
+
+
+def _approx_sq(row, col):
+    """Rank-1 reconstruction of the factored second moment's rsqrt:
+    ``rsqrt(row/mean(row)) x rsqrt(col)`` (Adafactor eq. 4)."""
+    r = jax.lax.rsqrt(
+        row / jnp.mean(row, axis=-1, keepdims=True)
+    )[..., :, None]
+    c = jax.lax.rsqrt(col)[..., None, :]
+    return r * c
+
+
+class _Q8:
+    """int8 blockwise codec for a full-size moment leaf (shares
+    :class:`~dlrover_tpu.optim.low_bit.QMoment` with q_adamw)."""
+
+    def __init__(self, block: int):
+        self.block = block
+
+    def quant(self, x) -> QMoment:
+        return _quant(x, self.block)
+
+    def dequant(self, qm: QMoment, shape):
+        return _dequant(qm, shape)
+
+
+class _F32:
+    """fp32 passthrough codec (the unquantized variants)."""
+
+    def quant(self, x):
+        return x
+
+    def dequant(self, x, shape):
+        return x
+
+
+class FactoredMoment(NamedTuple):
+    """Second-moment statistics: factored row/col for >=2-D leaves,
+    a full buffer for vectors/scalars (stored in ``full``)."""
+
+    row: jax.Array
+    col: jax.Array
+    full: jax.Array
+
+
+def _factored_precondition(g, nu, b2, eps1, clip_threshold):
+    """Shared Adafactor/CAME core: row/col EMA of ``grad^2 + eps1``,
+    rank-1 rsqrt preconditioning, RMS clip.  Returns the clipped
+    update direction and the new :class:`FactoredMoment`."""
+    sq = jnp.square(g) + eps1
+    if _factored(g.shape):
+        row = b2 * nu.row + (1 - b2) * jnp.mean(sq, axis=-1)
+        col = b2 * nu.col + (1 - b2) * jnp.mean(sq, axis=-2)
+        u = _approx_sq(row, col) * g
+        nu = nu._replace(row=row, col=col)
+    else:
+        full = b2 * nu.full + (1 - b2) * sq
+        u = jax.lax.rsqrt(full) * g
+        nu = nu._replace(full=full)
+    u = u / jnp.maximum(1.0, _rms(u) / clip_threshold)
+    return u, nu
+
+
+def _init_factored(p) -> FactoredMoment:
+    if _factored(p.shape):
+        return FactoredMoment(
+            row=jnp.zeros(p.shape[:-1], jnp.float32),
+            col=jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32),
+            full=jnp.zeros((), jnp.float32),
+        )
+    return FactoredMoment(
+        row=jnp.zeros((), jnp.float32),
+        col=jnp.zeros((), jnp.float32),
+        full=jnp.zeros(p.shape, jnp.float32),
+    )
+
+
+class CameState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates           # first moment (codec-encoded)
+    nu: optax.Updates           # FactoredMoment of grad^2
+    res: optax.Updates          # FactoredMoment of (u - mu)^2
+
+
+def came(
+    learning_rate: float = 2e-4,
+    betas: tuple = (0.9, 0.999, 0.9999),
+    eps: tuple = (1e-30, 1e-16),
+    clip_threshold: float = 1.0,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """CAME with fp32 states."""
+    return _came(
+        learning_rate, betas, eps, clip_threshold, weight_decay,
+        codec=_F32(),
+    )
+
+
+def q_came(
+    learning_rate: float = 2e-4,
+    betas: tuple = (0.9, 0.999, 0.9999),
+    eps: tuple = (1e-30, 1e-16),
+    clip_threshold: float = 1.0,
+    weight_decay: float = 0.0,
+    block_size: int = DEFAULT_BLOCK,
+) -> optax.GradientTransformation:
+    """CAME with the O(n) first moment stored blockwise-int8 —
+    optimizer HBM is ~1 byte/param + O(rows+cols) fp32 factors."""
+    return _came(
+        learning_rate, betas, eps, clip_threshold, weight_decay,
+        codec=_Q8(block_size),
+    )
+
+
+def _came(lr, betas, eps, clip_threshold, weight_decay, codec):
+    b1, b2, b3 = betas
+    eps1, eps2 = eps
+
+    def init_fn(params):
+        return CameState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(
+                lambda p: codec.quant(jnp.zeros_like(p, jnp.float32)),
+                params,
+            ),
+            nu=jax.tree.map(_init_factored, params),
+            res=jax.tree.map(_init_factored, params),
+        )
+
+    def leaf_update(g, mu_q, nu, res, p):
+        g = g.astype(jnp.float32)
+        u, nu = _factored_precondition(
+            g, nu, b2, eps1, clip_threshold
+        )
+        m = b1 * codec.dequant(mu_q, g.shape) + (1 - b1) * u
+        if _factored(g.shape):
+            r = jnp.square(u - m) + eps2
+            rrow = b3 * res.row + (1 - b3) * jnp.mean(r, axis=-1)
+            rcol = b3 * res.col + (1 - b3) * jnp.mean(r, axis=-2)
+            final = _approx_sq(rrow, rcol) * m
+            res = res._replace(row=rrow, col=rcol)
+        else:
+            final = m
+        upd = -lr * (final + weight_decay * p.astype(jnp.float32))
+        return upd.astype(p.dtype), codec.quant(m), nu, res
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("came requires params")
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat = [
+            leaf_update(g, m, n, r, p)
+            for g, m, n, r, p in zip(
+                flat_g,
+                treedef.flatten_up_to(state.mu),
+                treedef.flatten_up_to(state.nu),
+                treedef.flatten_up_to(state.res),
+                treedef.flatten_up_to(params),
+            )
+        ]
+        return (
+            treedef.unflatten([f[0] for f in flat]),
+            CameState(
+                count=state.count + 1,
+                mu=treedef.unflatten([f[1] for f in flat]),
+                nu=treedef.unflatten([f[2] for f in flat]),
+                res=treedef.unflatten([f[3] for f in flat]),
+            ),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class AdafactorState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates           # codec-encoded (None-like zeros if beta1 None)
+    nu: optax.Updates
+
+
+def q_adafactor(
+    learning_rate: Optional[float] = None,
+    beta1: Optional[float] = 0.9,
+    decay_rate: float = 0.8,
+    eps: tuple = (1e-30, 1e-3),
+    clip_threshold: float = 1.0,
+    weight_decay: float = 0.0,
+    scale_parameter: bool = True,
+    warmup_init: bool = False,
+    block_size: int = DEFAULT_BLOCK,
+) -> optax.GradientTransformation:
+    """Adafactor with the first moment stored blockwise-int8.
+
+    ``learning_rate=None`` uses the relative-step schedule
+    ``min(1/sqrt(t), 1e-2)`` (times ``1e-6*t`` warmup when
+    ``warmup_init``); ``scale_parameter`` multiplies by
+    ``max(eps[1], rms(p))``.  With ``beta1=None`` no first moment is
+    kept at all (the classic memory-optimal mode) and quantization is
+    moot.
+    """
+    codec = _Q8(block_size)
+
+    def init_fn(params):
+        mu = (
+            jax.tree.map(
+                lambda p: codec.quant(
+                    jnp.zeros_like(p, jnp.float32)
+                ),
+                params,
+            )
+            if beta1 is not None
+            else jax.tree.map(lambda p: jnp.zeros(()), params)
+        )
+        return AdafactorState(
+            count=jnp.zeros((), jnp.int32),
+            mu=mu,
+            nu=jax.tree.map(_init_factored, params),
+        )
+
+    def step_size(count, p):
+        if learning_rate is not None:
+            lr = jnp.asarray(learning_rate, jnp.float32)
+        else:
+            t = count.astype(jnp.float32)
+            min_step = (
+                1e-6 * t if warmup_init else jnp.asarray(1e-2)
+            )
+            lr = jnp.minimum(min_step, jax.lax.rsqrt(t))
+        if scale_parameter:
+            lr = lr * jnp.maximum(
+                eps[1], _rms(p.astype(jnp.float32))
+            )
+        return lr
+
+    def leaf_update(g, mu_q, nu, p, count):
+        g = g.astype(jnp.float32)
+        t = count.astype(jnp.float32)
+        b2 = 1.0 - t**-decay_rate
+        u, nu = _factored_precondition(
+            g, nu, b2, eps[0], clip_threshold
+        )
+        lr = step_size(count, p)
+        if beta1 is not None:
+            m = beta1 * codec.dequant(mu_q, g.shape) + (
+                1 - beta1
+            ) * u
+            final, new_mu = m, codec.quant(m)
+        else:
+            final, new_mu = u, mu_q
+        upd = -lr * (final + weight_decay * p.astype(jnp.float32))
+        return upd.astype(p.dtype), new_mu, nu
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("q_adafactor requires params")
+        count = state.count + 1
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat = [
+            leaf_update(g, m, n, p, count)
+            for g, m, n, p in zip(
+                flat_g,
+                treedef.flatten_up_to(state.mu),
+                treedef.flatten_up_to(state.nu),
+                treedef.flatten_up_to(params),
+            )
+        ]
+        return (
+            treedef.unflatten([f[0] for f in flat]),
+            AdafactorState(
+                count=count,
+                mu=treedef.unflatten([f[1] for f in flat]),
+                nu=treedef.unflatten([f[2] for f in flat]),
+            ),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

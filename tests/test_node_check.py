@@ -79,7 +79,10 @@ def test_injected_straggler_isolated_via_probe_timings(tmp_path):
                 JAX_PLATFORMS="cpu",
                 PYTHONPATH="/root/repo",
                 MOCK_STRAGGLER_RANK="1",
-                MOCK_STRAGGLER_DELAY="6.0",
+                # large margin over the >2x-median rule: on a loaded
+                # machine the healthy ranks' probe itself can take
+                # several seconds, lifting the median
+                MOCK_STRAGGLER_DELAY="20.0",
                 DLROVER_SHARED_DIR=str(tmp_path / "sockets"),
             )
             procs.append(subprocess.Popen(
