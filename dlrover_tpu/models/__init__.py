@@ -3,5 +3,16 @@ strategy engine's dry-runner, and the benchmarks."""
 
 from dlrover_tpu.models.gpt import GPT, GPTConfig
 from dlrover_tpu.models.llama import Llama, LlamaConfig
+from dlrover_tpu.models.losses import (
+    chunked_cross_entropy,
+    chunked_loss_fn,
+)
 
-__all__ = ["GPT", "GPTConfig", "Llama", "LlamaConfig"]
+__all__ = [
+    "GPT",
+    "GPTConfig",
+    "Llama",
+    "LlamaConfig",
+    "chunked_cross_entropy",
+    "chunked_loss_fn",
+]

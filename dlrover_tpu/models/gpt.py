@@ -282,7 +282,9 @@ class GPT(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(
+        self, tokens: jax.Array, return_hidden: bool = False
+    ) -> jax.Array:
         cfg = self.config
         b, s = tokens.shape
         wte = nn.Embed(
@@ -313,6 +315,11 @@ class GPT(nn.Module):
             )
             x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            # for chunked/fused losses that apply the head themselves
+            # (models/losses.py) — the [b, s, vocab] logits never
+            # materialize in one piece
+            return x.astype(cfg.dtype)
         if cfg.head == "value":
             # scalar value head (RLHF critic / reward models)
             v = nn.Dense(

@@ -207,7 +207,9 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(
+        self, tokens: jax.Array, return_hidden: bool = False
+    ) -> jax.Array:
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
@@ -219,6 +221,9 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"block_{i}")(x)
         x = RMSNorm(cfg.rms_eps, name="ln_f")(x)
+        if return_hidden:
+            # for chunked/fused losses (models/losses.py)
+            return x
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="lm_head",
