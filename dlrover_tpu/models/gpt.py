@@ -268,6 +268,7 @@ class Block(nn.Module):
                 mlp_dim=cfg.mlp_ratio * cfg.hidden_dim,
                 top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
+                no_drop=cfg.decode,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 name="moe",
@@ -311,7 +312,10 @@ class GPT(nn.Module):
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
             use_moe = (
-                cfg.moe_experts > 0 and i % cfg.moe_every == 1
+                # shared convention with Llama: every moe_every-th
+                # block (moe_every=1 -> all, =2 -> blocks 1,3,5...)
+                cfg.moe_experts > 0
+                and (i + 1) % cfg.moe_every == 0
             )
             x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

@@ -41,6 +41,13 @@ class LlamaConfig:
     # absolute positions continued across chunks; the cache stores
     # post-RoPE keys at kv-head granularity (GQA-aware)
     decode: bool = False
+    # Mixtral-style sparse MoE: >0 replaces the SwiGLU MLP of every
+    # ``moe_every``-th block with gated (SwiGLU) experts dispatched
+    # over the ``expert`` mesh axis
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 1
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -64,6 +71,16 @@ class LlamaConfig:
             vocab_size=128256, max_seq_len=8192, num_layers=32,
             num_heads=32, num_kv_heads=8, hidden_dim=4096,
             intermediate_dim=14336, rope_theta=500000.0, **kw,
+        )
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "LlamaConfig":
+        """Mixtral-class sparse MoE (8 experts, top-2, GQA)."""
+        return cls(
+            vocab_size=32000, max_seq_len=4096, num_layers=32,
+            num_heads=32, num_kv_heads=8, hidden_dim=4096,
+            intermediate_dim=14336, rope_theta=1e6,
+            moe_experts=8, moe_top_k=2, **kw,
         )
 
 
@@ -192,6 +209,7 @@ class LlamaMLP(nn.Module):
 
 class LlamaBlock(nn.Module):
     config: LlamaConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -199,7 +217,24 @@ class LlamaBlock(nn.Module):
         h = RMSNorm(cfg.rms_eps, name="ln_attn")(x)
         x = x + LlamaAttention(cfg, name="attn")(h)
         h = RMSNorm(cfg.rms_eps, name="ln_mlp")(x)
-        x = x + LlamaMLP(cfg, name="mlp")(h)
+        if self.use_moe:
+            from dlrover_tpu.parallel.moe import MoEMLP
+
+            mlp_out = MoEMLP(
+                num_experts=cfg.moe_experts,
+                hidden_dim=cfg.hidden_dim,
+                mlp_dim=cfg.intermediate_dim,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                no_drop=cfg.decode,
+                gated=True,  # SwiGLU experts (Mixtral)
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="moe",
+            )(h)
+        else:
+            mlp_out = LlamaMLP(cfg, name="mlp")(h)
+        x = x + mlp_out
         return x
 
 
@@ -219,7 +254,14 @@ class Llama(nn.Module):
         if cfg.remat:
             block = nn.remat(LlamaBlock, prevent_cse=False)
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x)
+            # shared convention with GPT: every moe_every-th block,
+            # counting from the end of the first stride (moe_every=1
+            # -> all blocks, =2 -> blocks 1,3,5...)
+            use_moe = (
+                cfg.moe_experts > 0
+                and (i + 1) % cfg.moe_every == 0
+            )
+            x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = RMSNorm(cfg.rms_eps, name="ln_f")(x)
         if return_hidden:
             # for chunked/fused losses (models/losses.py)

@@ -94,6 +94,13 @@ class MoEMLP(nn.Module):
     mlp_dim: int
     top_k: int = 2
     capacity_factor: float = 1.25
+    # gated experts (SwiGLU, Mixtral-style): w_gate/w_in project to
+    # mlp_dim, experts compute silu(gate) * up -> w_out
+    gated: bool = False
+    # decode/serving mode: capacity >= tokens so nothing is dropped
+    # (with a one-token decode step the trained capacity formula
+    # collapses to ~1 slot/expert and silently zeroes overflow)
+    no_drop: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -106,6 +113,10 @@ class MoEMLP(nn.Module):
         capacity = max(
             1, int(self.top_k * t * self.capacity_factor / e)
         )
+        if self.no_drop:
+            # each token's top-k choices are distinct experts, so t
+            # slots per expert always suffice
+            capacity = max(capacity, t)
 
         # router in fp32 for stable softmax/top-k
         gate_logits = nn.Dense(
@@ -117,15 +128,22 @@ class MoEMLP(nn.Module):
         )
         self.sow("intermediates", "moe_aux_loss", aux)
 
+        # per-expert fan-in scaling: the leading expert dim is a batch
+        # axis, not receptive field (plain lecun_normal would count it
+        # into fan_in and under-scale init std by sqrt(e))
+        expert_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal",
+            in_axis=-2, out_axis=-1, batch_axis=0,
+        )
         w_in = self.param(
             "experts_w_in",
-            nn.initializers.lecun_normal(),
+            expert_init,
             (e, d, self.mlp_dim),
             self.param_dtype,
         )
         w_out = self.param(
             "experts_w_out",
-            nn.initializers.lecun_normal(),
+            expert_init,
             (e, self.mlp_dim, d),
             self.param_dtype,
         )
@@ -138,7 +156,20 @@ class MoEMLP(nn.Module):
         h = jnp.einsum(
             "ecd,edh->ech", expert_in, w_in.astype(self.dtype)
         )
-        h = nn.gelu(h)
+        if self.gated:
+            w_gate = self.param(
+                "experts_w_gate",
+                expert_init,
+                (e, d, self.mlp_dim),
+                self.param_dtype,
+            )
+            gate_h = jnp.einsum(
+                "ecd,edh->ech", expert_in,
+                w_gate.astype(self.dtype),
+            )
+            h = nn.silu(gate_h) * h
+        else:
+            h = nn.gelu(h)
         expert_out = jnp.einsum(
             "ech,ehd->ecd", h, w_out.astype(self.dtype)
         )

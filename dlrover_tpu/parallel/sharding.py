@@ -189,7 +189,8 @@ def moe_rules() -> PartitionRules:
     base = gpt_tp_rules()
     return base.extended(
         [
-            (r"experts_w_(in|out)$", ("expert", "fsdp", "tensor")),
+            (r"experts_w_(in|out|gate)$",
+             ("expert", "fsdp", "tensor")),
             (r"router/kernel$", (None, None)),
         ]
     )
