@@ -165,6 +165,89 @@ def bench_train_step(jax, results: dict):
     )
 
 
+def bench_xl_train_step(jax, results: dict):
+    """GPT-2-XL (1.56B) on ONE chip — the reference's flash-ckpt
+    story model (docs/blogs/megatron_flash_checkpoint.md trains
+    GPT-1.5B).  Fits in 16 GB HBM via bf16 params + int8 (Pallas)
+    optimizer moments + flash attention + remat + buffer donation."""
+    from functools import partial
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models.gpt import (
+        GPT,
+        GPTConfig,
+        count_params,
+        cross_entropy_loss,
+    )
+    from dlrover_tpu.optim import q_adamw
+    from dlrover_tpu.trainer.elastic_trainer import TrainState
+
+    if os.getenv("BENCH_SMOKE"):
+        return
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev)
+    batch, seq = 4, 1024
+    cfg = GPTConfig(
+        num_layers=48, num_heads=25, hidden_dim=1600,
+        max_seq_len=seq, attention_impl="flash", remat=True,
+        param_dtype=jnp.bfloat16,
+    )
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=seq)
+    opt = q_adamw(learning_rate=3e-4, weight_decay=0.1)
+    state = TrainState.create(params, opt)
+    n = count_params(params)
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p, t: cross_entropy_loss(
+                model.apply({"params": p}, t[:, :-1]), t[:, 1:]
+            )
+        )(state.params, tokens)
+        updates, new_opt = opt.update(
+            grads, state.opt_state, state.params
+        )
+        return (
+            TrainState(
+                params=optax.apply_updates(state.params, updates),
+                opt_state=new_opt, step=state.step + 1,
+            ),
+            loss,
+        )
+
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32
+        )
+    )
+    state, loss = step(state, tokens)  # compile + warm
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        state, loss = step(state, tokens)
+    loss = float(loss)
+    dt = (time.perf_counter() - t0) / 4
+    tokens_per_s = batch * seq / dt
+    flops_per_token = 6 * n + 12 * cfg.num_layers * seq * (
+        cfg.hidden_dim
+    )
+    results["xl_train_step"] = {
+        "model": "gpt2_xl",
+        "num_params": n,
+        "batch": batch,
+        "seq_len": seq,
+        "recipe": "bf16 params + int8 moments + flash + remat",
+        "step_time_s": round(dt, 4),
+        "tokens_per_s": round(tokens_per_s, 1),
+        "mfu": round(flops_per_token * tokens_per_s / peak, 4),
+        "loss": loss,
+    }
+
+
 def bench_attention_kernel(jax, results: dict):
     """Microbench: Pallas flash attention vs plain XLA attention,
     fwd+bwd at a training seq len and a long-context one (where XLA
@@ -482,6 +565,14 @@ def main() -> int:
                 f"{type(e).__name__}: {e}"
             )
             time.sleep(5)
+    for attempt in (1, 2):
+        try:
+            bench_xl_train_step(jax, results)
+            results.pop("xl_train_step_error", None)
+            break
+        except Exception as e:  # noqa: BLE001
+            results["xl_train_step_error"] = f"{type(e).__name__}: {e}"
+            time.sleep(10)
     speedup = 0.0
     try:
         speedup = bench_flash_ckpt(jax, results, workdir)

@@ -115,8 +115,13 @@ class MoEMLP(nn.Module):
         )
         if self.no_drop:
             # each token's top-k choices are distinct experts, so t
-            # slots per expert always suffice
-            capacity = max(capacity, t)
+            # slots per expert always suffice.  Bound the bump at 512
+            # so large prefill chunks don't get [t, e, t]-sized
+            # dispatch tensors (quadratic in chunk length): decode
+            # steps (t = batch) get the hard no-drop guarantee, long
+            # prefill keeps the trained capacity factor — the same
+            # dropping behavior the weights were trained under.
+            capacity = max(capacity, min(t, 512))
 
         # router in fp32 for stable softmax/top-k
         gate_logits = nn.Dense(
