@@ -51,6 +51,12 @@ def _peak_flops(device) -> float:
     return 197e12  # conservative default: v5e-class
 
 
+def _flops_per_token(cfg, n_params: int, seq: int) -> float:
+    """PaLM-appendix accounting: 6N per token for the matmuls plus
+    the causal-attention term 12 * L * seq * hidden."""
+    return 6 * n_params + 12 * cfg.num_layers * seq * cfg.hidden_dim
+
+
 def bench_train_step(jax, results: dict):
     """GPT-2-small train step: tokens/s + MFU, flash vs xla attention."""
     import jax.numpy as jnp
@@ -132,11 +138,7 @@ def bench_train_step(jax, results: dict):
         loss = float(loss)
         dt = (time.perf_counter() - t0) / steps
         tokens_per_s = batch * seq / dt
-        # PaLM-appendix accounting: 6N per token for the matmuls plus
-        # the causal-attention term 12 * L * seq * hidden
-        flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * (
-            cfg.hidden_dim
-        )
+        flops_per_token = _flops_per_token(cfg, n_params, seq)
         mfu = flops_per_token * tokens_per_s / peak
         return {
             "step_time_s": round(dt, 4),
@@ -232,9 +234,7 @@ def bench_xl_train_step(jax, results: dict):
     loss = float(loss)
     dt = (time.perf_counter() - t0) / 4
     tokens_per_s = batch * seq / dt
-    flops_per_token = 6 * n + 12 * cfg.num_layers * seq * (
-        cfg.hidden_dim
-    )
+    flops_per_token = _flops_per_token(cfg, n, seq)
     results["xl_train_step"] = {
         "model": "gpt2_xl",
         "num_params": n,

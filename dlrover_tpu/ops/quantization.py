@@ -200,9 +200,17 @@ def _qadam_kernel(
     g = g_ref[:].astype(jnp.float32)
     p = p_ref[:].astype(jnp.float32)
     mu = qmu_ref[:].astype(jnp.float32) * mus_ref[:]
-    nu = qnu_ref[:].astype(jnp.float32) * nus_ref[:]
+    # nu is stored in the SQRT domain: nu = (q * scale)^2.  Linear
+    # int8 storage is unstable — a coordinate with
+    # absmax/127 < |g| < absmax/11 keeps mu != 0 while its nu
+    # quantizes to 0, so m_hat/(sqrt(0)+eps) explodes.  In the sqrt
+    # domain the mu and nu cutoffs coincide (both at |g| ~
+    # rowmax/127): wherever nu rounds to zero, mu does too and the
+    # update is a benign zero.  (Same reasoning as the 4-bit
+    # variant's quantize_blockwise_4bit_sqrt.)
+    nu_sqrt_prev = qnu_ref[:].astype(jnp.float32) * nus_ref[:]
+    nu = b2 * nu_sqrt_prev * nu_sqrt_prev + (1.0 - b2) * g * g
     mu = b1 * mu + (1.0 - b1) * g
-    nu = b2 * nu + (1.0 - b2) * g * g
     bc1 = hyp_ref[0, 0]
     bc2 = hyp_ref[0, 1]
     m_hat = mu / bc1
@@ -214,10 +222,12 @@ def _qadam_kernel(
         jnp.round(mu / mu_scale), -127, 127
     ).astype(jnp.int8)
     mus_out[:] = mu_scale
-    nu_absmax = jnp.max(jnp.abs(nu), axis=-1, keepdims=True)
-    nu_scale = jnp.maximum(nu_absmax / 127.0, 1e-12)
+    nu_sqrt = jnp.sqrt(nu)
+    nu_scale = jnp.maximum(
+        jnp.max(nu_sqrt, axis=-1, keepdims=True) / 127.0, 1e-12
+    )
     qnu_out[:] = jnp.clip(
-        jnp.round(nu / nu_scale), -127, 127
+        jnp.round(nu_sqrt / nu_scale), 0, 127
     ).astype(jnp.int8)
     nus_out[:] = nu_scale
 

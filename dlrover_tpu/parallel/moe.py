@@ -97,9 +97,11 @@ class MoEMLP(nn.Module):
     # gated experts (SwiGLU, Mixtral-style): w_gate/w_in project to
     # mlp_dim, experts compute silu(gate) * up -> w_out
     gated: bool = False
-    # decode/serving mode: capacity >= tokens so nothing is dropped
-    # (with a one-token decode step the trained capacity formula
-    # collapses to ~1 slot/expert and silently zeroes overflow)
+    # decode/serving mode: for single-token decode steps and chunks
+    # <= 512 tokens, capacity >= tokens so nothing is dropped (the
+    # trained capacity formula collapses to ~1 slot/expert there and
+    # silently zeroes overflow); longer prefill chunks keep the
+    # trained capacity factor
     no_drop: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -113,15 +115,16 @@ class MoEMLP(nn.Module):
         capacity = max(
             1, int(self.top_k * t * self.capacity_factor / e)
         )
-        if self.no_drop:
+        if self.no_drop and (s == 1 or t <= 512):
             # each token's top-k choices are distinct experts, so t
-            # slots per expert always suffice.  Bound the bump at 512
-            # so large prefill chunks don't get [t, e, t]-sized
-            # dispatch tensors (quadratic in chunk length): decode
-            # steps (t = batch) get the hard no-drop guarantee, long
-            # prefill keeps the trained capacity factor — the same
-            # dropping behavior the weights were trained under.
-            capacity = max(capacity, min(t, 512))
+            # slots per expert always suffice.  The hard guarantee
+            # covers single-token decode steps (t = batch, dispatch
+            # is [b, e, b] — linear in sequence) and short chunks;
+            # LONG prefill chunks keep the trained capacity factor —
+            # [t, e, t] dispatch at t = batch*seq would be quadratic
+            # in chunk length, and dropping there mirrors exactly
+            # what the weights saw in training.
+            capacity = max(capacity, t)
 
         # router in fp32 for stable softmax/top-k
         gate_logits = nn.Dense(
