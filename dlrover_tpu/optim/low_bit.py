@@ -25,6 +25,15 @@ from dlrover_tpu.ops.quantization import (
 
 
 class QMoment(NamedTuple):
+    """Blockwise-int8 moment storage.
+
+    DOMAIN NOTE: in the 8-bit fused path, ``mu`` is linear
+    (``value = q * scale``) but ``nu`` is stored in the SQRT domain
+    (``value = (q * scale)^2``) — see ``_qadam_kernel`` for why
+    (aligned mu/nu quantization cutoffs).  ``_dequant`` below is the
+    LINEAR codec only; do not apply it to a fused-path ``nu`` leaf.
+    """
+
     values: jax.Array   # int8 [rows, block]
     scales: jax.Array   # f32 [rows, 1]
 

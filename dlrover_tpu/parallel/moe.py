@@ -21,6 +21,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from dlrover_tpu.common.log import default_logger as logger
+
 
 def top_k_gating(
     gate_logits: jax.Array,  # [tokens, experts] f32
@@ -115,16 +117,24 @@ class MoEMLP(nn.Module):
         capacity = max(
             1, int(self.top_k * t * self.capacity_factor / e)
         )
-        if self.no_drop and (s == 1 or t <= 512):
+        if self.no_drop:
             # each token's top-k choices are distinct experts, so t
-            # slots per expert always suffice.  The hard guarantee
-            # covers single-token decode steps (t = batch, dispatch
-            # is [b, e, b] — linear in sequence) and short chunks;
-            # LONG prefill chunks keep the trained capacity factor —
-            # [t, e, t] dispatch at t = batch*seq would be quadratic
-            # in chunk length, and dropping there mirrors exactly
-            # what the weights saw in training.
-            capacity = max(capacity, t)
+            # slots per expert always suffice — but [t, e, t]
+            # dispatch tensors are quadratic in t, so the hard
+            # guarantee is bounded: up to 2048 tokens for one-token
+            # decode steps, 512 for prefill chunks.  Beyond that the
+            # trained capacity factor applies (the same dropping the
+            # weights saw in training).  Shapes are static under
+            # trace, so the warning fires at compile time.
+            bound = 2048 if s == 1 else 512
+            if t > bound:
+                logger.warning(
+                    "no_drop MoE: %d tokens exceeds the bounded "
+                    "no-drop guarantee (%d); trained capacity "
+                    "factor applies and overflow tokens may drop",
+                    t, bound,
+                )
+            capacity = max(capacity, min(t, bound))
 
         # router in fp32 for stable softmax/top-k
         gate_logits = nn.Dense(
