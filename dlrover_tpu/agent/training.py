@@ -34,6 +34,8 @@ from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.constants import (
     NetworkCheckConstant,
     NodeEnv,
+    NodeExitReason,
+    NodeStatus,
     RendezvousConstant,
     RendezvousName,
     TrainingExceptionLevel,
@@ -178,6 +180,15 @@ class ElasticTrainingAgent:
                 ),
                 HeartbeatReporter(client=self._client),
             ]
+            from dlrover_tpu.agent.preemption import (
+                PreemptionMonitor,
+                monitor_enabled,
+            )
+
+            if monitor_enabled():
+                self._monitors.append(
+                    PreemptionMonitor(self._on_preemption_notice)
+                )
 
     # -- worker process management ----------------------------------------
 
@@ -282,6 +293,34 @@ class ElasticTrainingAgent:
                 self._save_ckpt_hook()
             except Exception as e:  # noqa: BLE001
                 logger.error("breakpoint checkpoint save failed: %s", e)
+
+    def _on_preemption_notice(self):
+        """Advance warning from the metadata server (~30 s before the
+        VM dies).  The checkpoint save starts IMMEDIATELY — the
+        master report runs in a side thread so its retrying RPC
+        (seconds of backoff when the master is unreachable) can never
+        eat the preemption window the save needs.  The master's
+        DistributedJobManager routes the report through the relaunch
+        path, so replacement placement starts without waiting for
+        the pod watcher to see the VM die."""
+        import threading
+
+        def report():
+            try:
+                self._client.report_node_event(
+                    event_type="preemption_notice",
+                    status=NodeStatus.FAILED,
+                    exit_reason=NodeExitReason.PREEMPTED,
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "preemption report to master failed: %s", e
+                )
+
+        threading.Thread(
+            target=report, daemon=True, name="preemption-report"
+        ).start()
+        self._save_ckpt_at_breakpoint()
 
     # -- health check -------------------------------------------------------
 

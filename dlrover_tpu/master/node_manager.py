@@ -118,6 +118,29 @@ class DistributedJobManager(JobManager):
         if node.status in (NodeStatus.FAILED, NodeStatus.DELETED):
             self._handle_node_exit(node)
 
+    def update_node_status(
+        self,
+        node_id: int,
+        node_type: str,
+        status: str,
+        exit_reason: str = "",
+    ):
+        """Agent-reported transitions (servicer NodeEventReport) get
+        the same relaunch treatment as watcher-observed pod deaths —
+        an advance preemption notice starts replacement placement
+        immediately instead of waiting for the pod watcher to see the
+        VM die.  Idempotent with the later watcher event:
+        ``_relaunch_node`` marks the node released, which
+        ``_should_relaunch`` rejects on the second trigger."""
+        super().update_node_status(
+            node_id, node_type, status, exit_reason
+        )
+        node = self.get_node(node_id)
+        if node is not None and node.status in (
+            NodeStatus.FAILED, NodeStatus.DELETED
+        ):
+            self._handle_node_exit(node)
+
     def _handle_node_exit(self, node: Node):
         if self._should_relaunch(node):
             self._relaunch_node(node)

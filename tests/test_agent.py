@@ -217,3 +217,115 @@ def test_tpurun_auto_config():
     # negative values are treated as auto, never zero workers
     args = parse_args(["--nproc_per_node", "-1", "t.py"])
     assert apply_auto_config(args).nproc_per_node == 1
+
+
+# -- preemption monitor -------------------------------------------------
+
+
+class _FakeMetadata:
+    """Local stand-in for the GCE metadata server: serves FALSE until
+    flipped, then TRUE (instance/preempted semantics)."""
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                body = b"TRUE" if fake.preempted else b"FALSE"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence
+                pass
+
+        self.preempted = False
+        self._srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self.url = f"http://127.0.0.1:{self._srv.server_port}/preempted"
+        threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_preemption_monitor_fires_once_on_notice():
+    from dlrover_tpu.agent.preemption import PreemptionMonitor
+
+    meta = _FakeMetadata()
+    fired = []
+    mon = PreemptionMonitor(
+        lambda: fired.append(time.time()), metadata_url=meta.url,
+        poll_interval=0.05,
+    )
+    try:
+        mon.start()
+        time.sleep(0.3)
+        assert not fired  # FALSE -> no callback
+        meta.preempted = True
+        deadline = time.time() + 5
+        while not fired and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(fired) == 1
+        time.sleep(0.2)
+        assert len(fired) == 1  # fires once, thread exits
+    finally:
+        mon.stop()
+        meta.close()
+
+
+def test_agent_preemption_notice_saves_ckpt_and_reports(
+    master, client, monkeypatch
+):
+    """Advance preemption notice -> breakpoint-checkpoint hook runs
+    and the master sees the node transition with exit_reason
+    'preempted' (instead of waiting for a heartbeat timeout)."""
+    from dlrover_tpu.agent.preemption import ENV_METADATA_URL
+
+    meta = _FakeMetadata()
+    monkeypatch.setenv(ENV_METADATA_URL, meta.url)
+    saved = []
+    spec = WorkerSpec(
+        entrypoint=[sys.executable, "-c", "import time; time.sleep(30)"],
+        nproc_per_node=1, max_restarts=0, monitor_interval=0.2,
+    )
+    agent = ElasticTrainingAgent(
+        spec, client=client, node_rank=0, start_monitors=True,
+        save_ckpt_hook=lambda: saved.append(True),
+    )
+    mon = agent._monitors[-1]
+    from dlrover_tpu.agent.preemption import PreemptionMonitor
+
+    assert isinstance(mon, PreemptionMonitor)
+    mon._poll_interval = 0.05
+    try:
+        for m in agent._monitors:
+            m.start()
+        meta.preempted = True
+        deadline = time.time() + 5
+        while not saved and time.time() < deadline:
+            time.sleep(0.05)
+        assert saved, "breakpoint checkpoint hook did not run"
+        # master saw the advance notice
+        deadline = time.time() + 3
+        node = None
+        while time.time() < deadline:
+            n = master.job_manager.get_node(0)
+            if n is not None and n.exit_reason == "preempted":
+                node = n
+                break
+            time.sleep(0.05)
+        assert node is not None, "master did not record preemption"
+    finally:
+        for m in agent._monitors:
+            m.stop()
+        agent.stop()
+        meta.close()

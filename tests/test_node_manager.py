@@ -344,3 +344,35 @@ def test_evaluator_node_group(k8s):
         assert not evaluators[0].is_released
     finally:
         mgr.stop()
+
+
+def test_agent_reported_preemption_relaunches_immediately(k8s):
+    """An agent-reported end state (advance GCE preemption notice via
+    NodeEventReport -> update_node_status) triggers the same relaunch
+    path as a watcher-observed pod death — and stays idempotent when
+    the watcher later sees the pod actually die."""
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-0", "Running")
+        assert _wait_until(
+            lambda: mgr.get_node(0) is not None
+            and mgr.get_node(0).status == NodeStatus.RUNNING
+        )
+        # agent reports the advance notice (servicer path)
+        mgr.update_node_status(
+            0, NodeType.WORKER, NodeStatus.FAILED,
+            exit_reason=NodeExitReason.PREEMPTED,
+        )
+        # replacement launched without any watcher event
+        assert _wait_until(lambda: "tj-worker-2" in api.pods)
+        assert mgr.get_node(2).rank_index == 0
+        # the watcher later observes the actual pod death: no second
+        # relaunch (node 0 already released)
+        api.set_pod_phase("tj-worker-0", "Failed", reason="Preempted")
+        time.sleep(0.5)
+        assert "tj-worker-3" not in api.pods
+    finally:
+        mgr.stop()
