@@ -185,12 +185,24 @@ def search_strategy(
     dry_run_budget: int = 6,
     grad_accums: Tuple[int, ...] = (1, 2),
     seed: int = 0,
+    rank_mode: str = "profile",
 ) -> SearchResult:
-    """Generate, prune, and dry-run rank; BO picks what to measure
-    when candidates exceed the budget (reference: bayes_opt_sg.py)."""
-    from dlrover_tpu.accel.dry_runner import profile_plan
+    """Generate, prune, and rank; BO picks what to measure when
+    candidates exceed the budget (reference: bayes_opt_sg.py).
+
+    ``rank_mode="profile"`` times real executions (ground truth);
+    ``"cost_model"`` compiles only and ranks by XLA's own
+    flops/bytes roofline (deterministic, never runs a step — for
+    noisy shared machines or search spaces too big to execute).
+    """
+    from dlrover_tpu.accel.dry_runner import (
+        estimate_plan,
+        profile_plan,
+    )
     from dlrover_tpu.accel.opt_lib import OptimizationLibrary
 
+    if rank_mode not in ("profile", "cost_model"):
+        raise ValueError(f"unknown rank_mode {rank_mode!r}")
     lib = OptimizationLibrary()
     cands = generate_candidates(context, num_devices, grad_accums)
     logger.info(
@@ -201,13 +213,19 @@ def search_strategy(
     def evaluate(cand: Candidate) -> float:
         plan = lib.apply_strategy(cand.strategy, context)
         plan.grad_accum = cand.grad_accum
-        result = profile_plan(plan, context, devices=devices)
-        cand.step_time_s = (
-            result.step_time_s if result.ok else float("inf")
-        )
+        if rank_mode == "cost_model":
+            result = estimate_plan(plan, context, devices=devices)
+            cand.step_time_s = (
+                result.est_step_time_s if result.ok else float("inf")
+            )
+        else:
+            result = profile_plan(plan, context, devices=devices)
+            cand.step_time_s = (
+                result.step_time_s if result.ok else float("inf")
+            )
         logger.info(
-            "candidate %s: ok=%s step=%.4fs",
-            cand.describe(), result.ok, result.step_time_s,
+            "candidate %s: ok=%s step=%.4fs (%s)",
+            cand.describe(), result.ok, cand.step_time_s, rank_mode,
         )
         return cand.step_time_s
 

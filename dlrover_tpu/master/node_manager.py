@@ -42,6 +42,10 @@ class DistributedJobManager(JobManager):
         self._scaler = scaler
         self._watcher = watcher
         self._id_iter = itertools.count(job_args.worker_count())
+        # serializes the relaunch decision: the agent-report path
+        # (servicer request thread) and the watcher path can deliver
+        # the same death concurrently
+        self._relaunch_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -142,7 +146,14 @@ class DistributedJobManager(JobManager):
             self._handle_node_exit(node)
 
     def _handle_node_exit(self, node: Node):
-        if self._should_relaunch(node):
+        with self._relaunch_lock:
+            relaunch = self._should_relaunch(node)
+            if relaunch:
+                # claim under the lock: a concurrent second delivery
+                # of the same death (agent report + watcher event)
+                # must not launch a second replacement
+                node.is_released = True
+        if relaunch:
             self._relaunch_node(node)
         elif node.critical or self._all_relaunches_exhausted():
             self.job_exit_reason = node.exit_reason or "node_failed"
@@ -162,6 +173,9 @@ class DistributedJobManager(JobManager):
             NodeExitReason.PREEMPTED,
             NodeExitReason.HARDWARE_ERROR,
             NodeExitReason.UNKNOWN,
+            # heartbeat-timeout failures (job_manager hang monitor):
+            # a hung node heals by replacement like a killed one
+            "no-heartbeat",
             "",
         )
 

@@ -376,3 +376,64 @@ def test_agent_reported_preemption_relaunches_immediately(k8s):
         assert "tj-worker-3" not in api.pods
     finally:
         mgr.stop()
+
+
+def test_concurrent_death_reports_launch_one_replacement(k8s):
+    """Agent report and watcher event can deliver the same death on
+    two threads; the relaunch claim is atomic so exactly one
+    replacement launches."""
+    import threading
+
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-0", "Running")
+        assert _wait_until(
+            lambda: mgr.get_node(0) is not None
+            and mgr.get_node(0).status == NodeStatus.RUNNING
+        )
+        node = mgr.get_node(0)
+        node.update_status(NodeStatus.FAILED)
+        node.exit_reason = NodeExitReason.PREEMPTED
+        barrier = threading.Barrier(2)
+
+        def deliver():
+            barrier.wait()
+            mgr._handle_node_exit(node)
+
+        threads = [
+            threading.Thread(target=deliver) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert _wait_until(lambda: "tj-worker-2" in api.pods)
+        time.sleep(0.3)
+        assert "tj-worker-3" not in api.pods, sorted(api.pods)
+    finally:
+        mgr.stop()
+
+
+def test_heartbeat_timeout_relaunches(k8s):
+    """A hang-detected node ('no-heartbeat' from the job manager's
+    heartbeat monitor) is replaced like a killed one."""
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-0", "Running")
+        assert _wait_until(
+            lambda: mgr.get_node(0) is not None
+            and mgr.get_node(0).status == NodeStatus.RUNNING
+        )
+        mgr.update_node_status(
+            0, NodeType.WORKER, NodeStatus.FAILED,
+            exit_reason="no-heartbeat",
+        )
+        assert _wait_until(lambda: "tj-worker-2" in api.pods)
+    finally:
+        mgr.stop()
