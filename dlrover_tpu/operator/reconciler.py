@@ -138,14 +138,69 @@ class ElasticJobReconciler:
         return phases
 
     def run(self, get_jobs, interval: float = 5.0, stop_event=None):
-        """Controller loop: poll CRs and reconcile (list+watch in the
-        real deployment; polling keeps the mock path simple)."""
+        """Polling controller loop (simple deployments / tests)."""
         while stop_event is None or not stop_event.is_set():
             try:
                 self.reconcile_once(get_jobs())
             except Exception:  # noqa: BLE001
                 logger.exception("reconcile failed")
             time.sleep(interval)
+
+    def run_watch(
+        self, get_jobs, stop_event, resync_interval: float = 30.0
+    ):
+        """Informer-style controller loop (the Go operator's
+        controller-runtime contract): a pod watch stream triggers a
+        reconcile immediately on any cluster change, and a periodic
+        resync covers events the stream missed.  A dying watch
+        stream degrades to resync-interval polling, never to a
+        stopped controller."""
+        import queue
+        import threading
+
+        wake: "queue.Queue[str]" = queue.Queue()
+        _STOP = "__stop__"
+
+        def pump():
+            try:
+                while not stop_event.is_set():
+                    try:
+                        for etype, _pod in self._client.watch_pods(
+                            "app=dlrover-tpu"
+                        ):
+                            wake.put(etype)
+                            if stop_event.is_set():
+                                return
+                    except Exception:  # noqa: BLE001
+                        logger.exception(
+                            "pod watch failed; retrying"
+                        )
+                    # stream ended (idle timeout / apiserver hiccup)
+                    stop_event.wait(0.5)
+            finally:
+                # unblock the main loop so shutdown is prompt, not
+                # delayed by up to resync_interval
+                wake.put(_STOP)
+
+        threading.Thread(
+            target=pump, daemon=True, name="elasticjob-watch"
+        ).start()
+        while not stop_event.is_set():
+            try:
+                self.reconcile_once(get_jobs())
+            except Exception:  # noqa: BLE001
+                logger.exception("reconcile failed")
+            try:
+                if wake.get(timeout=resync_interval) == _STOP:
+                    return
+                while True:  # drain the burst into one reconcile
+                    try:
+                        if wake.get_nowait() == _STOP:
+                            return
+                    except queue.Empty:
+                        break
+            except queue.Empty:
+                pass  # periodic resync
 
 
 def build_worker_pod(job_name: str, item: Dict) -> Dict:

@@ -129,16 +129,48 @@ class MockK8sApi(K8sApi):
     def __init__(self):
         self.pods: Dict[str, Dict] = {}
         self.custom_resources: Dict[str, Dict] = {}
-        self._events: "Queue[tuple]" = Queue()
+        # one persistent queue per label selector — real Kubernetes
+        # delivers each event to EVERY watch stream, so distinct
+        # consumers (the master's PodWatcher, the operator's
+        # run_watch) must not steal events from each other; keying by
+        # selector (rather than per-stream) also buffers events
+        # across a consumer's re-subscribe gap, like list+watch with
+        # a resourceVersion does
+        self._watchers: Dict[str, "Queue[tuple]"] = {}
+        self._watch_lock = threading.Lock()
+        # events that fired before a selector's first subscription
+        # are replayed to it (the mock's analog of list+watch from
+        # resourceVersion 0) — consumers must not lose the create/
+        # fail events that race their watch startup
+        self._history: List[tuple] = []
         self.create_calls = 0
         self.delete_calls = 0
+
+    def _emit(self, event: tuple):
+        with self._watch_lock:
+            self._history.append(event)
+            del self._history[:-1000]
+            watchers = list(self._watchers.values())
+        for q in watchers:
+            q.put(event)
+
+    def _watch_queue(self, label_selector: str) -> "Queue[tuple]":
+        with self._watch_lock:
+            key = label_selector or ""
+            q = self._watchers.get(key)
+            if q is None:
+                q = Queue()
+                for event in self._history:
+                    q.put(event)
+                self._watchers[key] = q
+            return q
 
     def create_pod(self, namespace, body):
         name = body["metadata"]["name"]
         body.setdefault("status", {})["phase"] = "Pending"
         self.pods[name] = body
         self.create_calls += 1
-        self._events.put(("added", dict(body)))
+        self._emit(("added", dict(body)))
         return True
 
     def delete_pod(self, namespace, name):
@@ -147,7 +179,7 @@ class MockK8sApi(K8sApi):
         if pod is not None:
             pod.setdefault("status", {})["phase"] = "Failed"
             pod["status"]["reason"] = "Deleted"
-            self._events.put(("deleted", dict(pod)))
+            self._emit(("deleted", dict(pod)))
         return True
 
     def set_pod_phase(self, name: str, phase: str, reason: str = "",
@@ -160,7 +192,7 @@ class MockK8sApi(K8sApi):
             pod["status"]["reason"] = reason
         if exit_code:
             pod["status"]["container_exit_code"] = exit_code
-        self._events.put(("modified", dict(pod)))
+        self._emit(("modified", dict(pod)))
 
     def list_pods(self, namespace, label_selector):
         return list(self.pods.values())
@@ -184,9 +216,10 @@ class MockK8sApi(K8sApi):
         ]
 
     def watch_pods(self, namespace, label_selector):
+        q = self._watch_queue(label_selector)
         while True:
             try:
-                yield self._events.get(timeout=1.0)
+                yield q.get(timeout=1.0)
             except Empty:
                 return
 

@@ -3,6 +3,7 @@ phase from the pod, CRD manifests are valid YAML with the reference's
 field surface."""
 
 import os
+import time
 
 import pytest
 
@@ -96,3 +97,44 @@ def test_reconciler_gc_deletes_orphaned_pods():
     # job j2's CR deleted -> its master pod is garbage-collected
     rec.reconcile_once({"j1": jobs["j1"]})
     assert list(api.pods) == ["elasticjob-j1-master"]
+
+
+def test_watch_driven_reconcile_recreates_master_promptly():
+    """run_watch reacts to pod events: a dead master pod is recreated
+    well within the (long) resync interval — event-driven, not
+    polling."""
+    import threading
+
+    api = MockK8sApi()
+    client = K8sClient(namespace="test", api=api)
+    rec = ElasticJobReconciler(client)
+    jobs = {
+        "wjob": {
+            "metadata": {"name": "wjob", "uid": "u1"},
+            "spec": {"replicaSpecs": {"worker": {"replicas": 2}}},
+        }
+    }
+    stop = threading.Event()
+    t = threading.Thread(
+        target=rec.run_watch,
+        args=(lambda: jobs, stop),
+        kwargs={"resync_interval": 30.0},
+        daemon=True,
+    )
+    t.start()
+    try:
+        deadline = time.time() + 5
+        name = master_pod_name("wjob")
+        while time.time() < deadline and name not in api.pods:
+            time.sleep(0.05)
+        assert name in api.pods
+        # master dies -> the deletion event wakes the controller;
+        # recreation must land far sooner than the 30s resync
+        api.delete_pod("test", name)
+        deadline = time.time() + 5
+        while time.time() < deadline and name not in api.pods:
+            time.sleep(0.05)
+        assert name in api.pods, "master pod not recreated by event"
+    finally:
+        stop.set()
+        t.join(timeout=3)
