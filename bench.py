@@ -227,12 +227,13 @@ def bench_xl_train_step(jax, results: dict):
         )
     )
     state, loss = step(state, tokens)  # compile + warm
-    float(loss)
+    loss0 = float(loss)
+    steps = 8  # past the transient Adam warm-up spike (~step 4)
     t0 = time.perf_counter()
-    for _ in range(4):
+    for _ in range(steps):
         state, loss = step(state, tokens)
     loss = float(loss)
-    dt = (time.perf_counter() - t0) / 4
+    dt = (time.perf_counter() - t0) / steps
     tokens_per_s = batch * seq / dt
     flops_per_token = _flops_per_token(cfg, n, seq)
     results["xl_train_step"] = {
@@ -244,6 +245,7 @@ def bench_xl_train_step(jax, results: dict):
         "step_time_s": round(dt, 4),
         "tokens_per_s": round(tokens_per_s, 1),
         "mfu": round(flops_per_token * tokens_per_s / peak, 4),
+        "loss_first": loss0,
         "loss": loss,
     }
 
