@@ -383,19 +383,19 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     try:
         # warm up (jit of the on-device copy, shm allocation)
         assert engine.save_to_storage(1, state_dict)
-        assert engine.wait_async(timeout=900.0)
+        assert engine.wait_async(timeout=1800.0)
         for step in (2, 3):
             t0 = time.perf_counter()
             ok = engine.save_to_storage(step, state_dict)
             stalls.append(time.perf_counter() - t0)
             assert ok, f"flash save of step {step} was skipped"
-            assert engine.wait_async(timeout=900.0)
+            assert engine.wait_async(timeout=1800.0)
             assert engine._last_async_error is None
 
         f_flash = statistics.median(stalls)
         # integrity: wait for the agent to persist + commit, then load
         tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
-        deadline = time.time() + 900
+        deadline = time.time() + 1800
         committed = -1
         while time.time() < deadline:
             if os.path.exists(tracker):
