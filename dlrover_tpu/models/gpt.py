@@ -37,6 +37,8 @@ class GPTConfig:
     hidden_dim: int = 768
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
+    # GPT-2's canonical layernorm epsilon (HF checkpoint fidelity)
+    ln_eps: float = 1e-5
     dtype: Any = jnp.bfloat16       # activation/compute dtype (MXU)
     param_dtype: Any = jnp.float32  # master params
     remat: bool = False
@@ -256,9 +258,13 @@ class Block(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
         # fp32 layernorms on the residual stream for stability
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        h = nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_attn"
+        )(x)
         x = x + Attention(cfg, name="attn")(h.astype(cfg.dtype))
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        h = nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_mlp"
+        )(x)
         if self.use_moe:
             from dlrover_tpu.parallel.moe import MoEMLP
 
@@ -318,7 +324,9 @@ class GPT(nn.Module):
                 and (i + 1) % cfg.moe_every == 0
             )
             x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_f"
+        )(x)
         if return_hidden:
             # for chunked/fused losses that apply the head themselves
             # (models/losses.py) — the [b, s, vocab] logits never
@@ -472,7 +480,7 @@ class PipelinedGPT:
             num_microbatches=self.num_microbatches,
             batch_axis=self.batch_axis,
         )
-        x = nn.LayerNorm(dtype=jnp.float32).apply(
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32).apply(
             {"params": pp["head"]["ln_f"]}, x
         )
         if cfg.tie_embeddings:

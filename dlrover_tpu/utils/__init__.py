@@ -2,7 +2,17 @@
 ``atorch/utils/`` — timer.py, prof.py, parse_trace_json.py,
 numberic_checker.py)."""
 
-from dlrover_tpu.utils.timer import Timer, Timers
 from dlrover_tpu.utils.numeric_checker import check_numerics
+from dlrover_tpu.utils.timer import Timer, Timers
+from dlrover_tpu.utils.torch_compat import (
+    gpt2_params_from_torch,
+    llama_params_from_torch,
+)
 
-__all__ = ["Timer", "Timers", "check_numerics"]
+__all__ = [
+    "Timer",
+    "Timers",
+    "check_numerics",
+    "gpt2_params_from_torch",
+    "llama_params_from_torch",
+]

@@ -1,0 +1,141 @@
+"""Torch/HuggingFace checkpoint interop.
+
+Reference users arrive with torch weights (the reference accelerates
+HF torch models directly — ``atorch/auto/accelerate.py`` wraps
+``transformers`` modules).  This module converts HF state dicts into
+this framework's flax param trees so a DLRover user can bring their
+GPT-2 or Llama checkpoint and keep training TPU-native:
+
+- :func:`gpt2_params_from_torch` — HF ``gpt2`` family
+  (``GPT2LMHeadModel``; Conv1D kernels are stored ``[in, out]`` and
+  map to flax Dense kernels unchanged).
+- :func:`llama_params_from_torch` — HF ``LlamaForCausalLM`` family
+  incl. GQA (``nn.Linear`` weights are ``[out, in]`` and transpose).
+
+Both accept a ``state_dict``-like mapping of numpy arrays or torch
+tensors; tensors are detached to numpy on the fly, so the torch
+dependency stays optional and CPU-only.
+"""
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (possibly bf16: numpy has no bfloat16, go via f32)
+    t = t.detach().cpu()
+    if str(t.dtype) == "torch.bfloat16":
+        t = t.float()
+    return t.numpy()
+
+
+def _strip_prefix(sd: Mapping[str, Any], prefixes=("transformer.",
+                                                   "model.")) -> Dict[str, Any]:
+    out = {}
+    for k, v in sd.items():
+        for p in prefixes:
+            if k.startswith(p):
+                k = k[len(p):]
+                break
+        out[k] = v
+    return out
+
+
+def gpt2_params_from_torch(state_dict: Mapping[str, Any]) -> Dict:
+    """HF GPT-2 state dict -> params for :class:`models.gpt.GPT`
+    (``tie_embeddings=True``; the lm head reuses ``wte``)."""
+    sd = _strip_prefix(state_dict)
+    params: Dict[str, Any] = {
+        "wte": {"embedding": _np(sd["wte.weight"])},
+        "wpe": {"embedding": _np(sd["wpe.weight"])},
+        "ln_f": {
+            "scale": _np(sd["ln_f.weight"]),
+            "bias": _np(sd["ln_f.bias"]),
+        },
+    }
+    i = 0
+    while f"h.{i}.ln_1.weight" in sd:
+        blk = f"h.{i}."
+        params[f"block_{i}"] = {
+            "ln_attn": {
+                "scale": _np(sd[blk + "ln_1.weight"]),
+                "bias": _np(sd[blk + "ln_1.bias"]),
+            },
+            "attn": {
+                # HF Conv1D stores [in, out] — flax Dense layout
+                "qkv": {
+                    "kernel": _np(sd[blk + "attn.c_attn.weight"]),
+                    "bias": _np(sd[blk + "attn.c_attn.bias"]),
+                },
+                "o_proj": {
+                    "kernel": _np(sd[blk + "attn.c_proj.weight"]),
+                    "bias": _np(sd[blk + "attn.c_proj.bias"]),
+                },
+            },
+            "ln_mlp": {
+                "scale": _np(sd[blk + "ln_2.weight"]),
+                "bias": _np(sd[blk + "ln_2.bias"]),
+            },
+            "mlp": {
+                "fc_in": {
+                    "kernel": _np(sd[blk + "mlp.c_fc.weight"]),
+                    "bias": _np(sd[blk + "mlp.c_fc.bias"]),
+                },
+                "fc_out": {
+                    "kernel": _np(sd[blk + "mlp.c_proj.weight"]),
+                    "bias": _np(sd[blk + "mlp.c_proj.bias"]),
+                },
+            },
+        }
+        i += 1
+    return params
+
+
+def llama_params_from_torch(state_dict: Mapping[str, Any]) -> Dict:
+    """HF Llama (incl. GQA) state dict -> params for
+    :class:`models.llama.Llama`."""
+    sd = _strip_prefix(state_dict)
+
+    def lin(key):  # nn.Linear [out, in] -> flax [in, out]
+        return {"kernel": _np(sd[key]).T}
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": _np(sd["embed_tokens.weight"])},
+        "ln_f": {"scale": _np(sd["norm.weight"])},
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = lin("lm_head.weight")
+    else:
+        # tied-embedding checkpoints reuse the input embedding
+        params["lm_head"] = {
+            "kernel": _np(sd["embed_tokens.weight"]).T
+        }
+    i = 0
+    while f"layers.{i}.input_layernorm.weight" in sd:
+        blk = f"layers.{i}."
+        params[f"block_{i}"] = {
+            "ln_attn": {
+                "scale": _np(sd[blk + "input_layernorm.weight"])
+            },
+            "attn": {
+                "q_proj": lin(blk + "self_attn.q_proj.weight"),
+                "k_proj": lin(blk + "self_attn.k_proj.weight"),
+                "v_proj": lin(blk + "self_attn.v_proj.weight"),
+                "o_proj": lin(blk + "self_attn.o_proj.weight"),
+            },
+            "ln_mlp": {
+                "scale": _np(
+                    sd[blk + "post_attention_layernorm.weight"]
+                )
+            },
+            "mlp": {
+                "gate": lin(blk + "mlp.gate_proj.weight"),
+                "up": lin(blk + "mlp.up_proj.weight"),
+                "down": lin(blk + "mlp.down_proj.weight"),
+            },
+        }
+        i += 1
+    return params
