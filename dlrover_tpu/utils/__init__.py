@@ -6,7 +6,9 @@ from dlrover_tpu.utils.numeric_checker import check_numerics
 from dlrover_tpu.utils.timer import Timer, Timers
 from dlrover_tpu.utils.torch_compat import (
     gpt2_params_from_torch,
+    gpt2_params_to_torch,
     llama_params_from_torch,
+    llama_params_to_torch,
 )
 
 __all__ = [
@@ -14,5 +16,7 @@ __all__ = [
     "Timers",
     "check_numerics",
     "gpt2_params_from_torch",
+    "gpt2_params_to_torch",
     "llama_params_from_torch",
+    "llama_params_to_torch",
 ]

@@ -139,3 +139,94 @@ def llama_params_from_torch(state_dict: Mapping[str, Any]) -> Dict:
         }
         i += 1
     return params
+
+
+# -- inverse direction: export to the torch ecosystem -------------------
+
+
+def gpt2_params_to_torch(params: Mapping[str, Any]) -> Dict[str, Any]:
+    """Flax GPT params -> HF GPT-2 state dict (numpy values; wrap
+    with ``torch.from_numpy`` to load into ``GPT2LMHeadModel``)."""
+    sd: Dict[str, Any] = {
+        "transformer.wte.weight": np.asarray(
+            params["wte"]["embedding"]
+        ),
+        "transformer.wpe.weight": np.asarray(
+            params["wpe"]["embedding"]
+        ),
+        "transformer.ln_f.weight": np.asarray(
+            params["ln_f"]["scale"]
+        ),
+        "transformer.ln_f.bias": np.asarray(params["ln_f"]["bias"]),
+        "lm_head.weight": np.asarray(params["wte"]["embedding"]),
+    }
+    i = 0
+    while f"block_{i}" in params:
+        b = params[f"block_{i}"]
+        blk = f"transformer.h.{i}."
+        sd[blk + "ln_1.weight"] = np.asarray(b["ln_attn"]["scale"])
+        sd[blk + "ln_1.bias"] = np.asarray(b["ln_attn"]["bias"])
+        sd[blk + "attn.c_attn.weight"] = np.asarray(
+            b["attn"]["qkv"]["kernel"]
+        )
+        sd[blk + "attn.c_attn.bias"] = np.asarray(
+            b["attn"]["qkv"]["bias"]
+        )
+        sd[blk + "attn.c_proj.weight"] = np.asarray(
+            b["attn"]["o_proj"]["kernel"]
+        )
+        sd[blk + "attn.c_proj.bias"] = np.asarray(
+            b["attn"]["o_proj"]["bias"]
+        )
+        sd[blk + "ln_2.weight"] = np.asarray(b["ln_mlp"]["scale"])
+        sd[blk + "ln_2.bias"] = np.asarray(b["ln_mlp"]["bias"])
+        sd[blk + "mlp.c_fc.weight"] = np.asarray(
+            b["mlp"]["fc_in"]["kernel"]
+        )
+        sd[blk + "mlp.c_fc.bias"] = np.asarray(
+            b["mlp"]["fc_in"]["bias"]
+        )
+        sd[blk + "mlp.c_proj.weight"] = np.asarray(
+            b["mlp"]["fc_out"]["kernel"]
+        )
+        sd[blk + "mlp.c_proj.bias"] = np.asarray(
+            b["mlp"]["fc_out"]["bias"]
+        )
+        i += 1
+    return sd
+
+
+def llama_params_to_torch(params: Mapping[str, Any]) -> Dict[str, Any]:
+    """Flax Llama params -> HF Llama state dict (numpy values)."""
+    sd: Dict[str, Any] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["wte"]["embedding"]
+        ),
+        "model.norm.weight": np.asarray(params["ln_f"]["scale"]),
+        "lm_head.weight": np.asarray(
+            params["lm_head"]["kernel"]
+        ).T,
+    }
+    i = 0
+    while f"block_{i}" in params:
+        b = params[f"block_{i}"]
+        blk = f"model.layers.{i}."
+        sd[blk + "input_layernorm.weight"] = np.asarray(
+            b["ln_attn"]["scale"]
+        )
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[blk + f"self_attn.{name}.weight"] = np.asarray(
+                b["attn"][name]["kernel"]
+            ).T
+        sd[blk + "post_attention_layernorm.weight"] = np.asarray(
+            b["ln_mlp"]["scale"]
+        )
+        for ours, theirs in (
+            ("gate", "gate_proj"), ("up", "up_proj"),
+            ("down", "down_proj"),
+        ):
+            sd[blk + f"mlp.{theirs}.weight"] = np.asarray(
+                b["mlp"][ours]["kernel"]
+            ).T
+        i += 1
+    return sd
