@@ -27,7 +27,7 @@ microbatch count — with gradients verified exact against the
 sequential computation.
 """
 
-from typing import Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -152,6 +152,17 @@ def pipeline_apply(
     return out
 
 
+class PipelineTrainResult(NamedTuple):
+    """Outputs of :func:`pipeline_train_step_1f1b` — a full vjp
+    segment so embed layers before and head layers after the pipeline
+    train end-to-end."""
+
+    loss: jax.Array
+    stage_grads: Any          # like stacked_params (stage-sharded)
+    head_grads: Any           # like head_params, or None
+    input_grads: jax.Array    # dLoss/dx, batch-sharded like x
+
+
 def pipeline_train_step_1f1b(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -162,6 +173,7 @@ def pipeline_train_step_1f1b(
     num_microbatches: int,
     axis: str = "pipeline",
     batch_axis=None,
+    head_params=None,
 ):
     """Interleaved (1F1B-style) pipelined training step.
 
@@ -175,21 +187,39 @@ def pipeline_train_step_1f1b(
     GPipe-under-autodiff's O(num_microbatches + stages) scan
     residuals; each backward recomputes its stage forward inside
     ``jax.vjp`` (inherent remat, same trade as ``pipeline_apply`` +
-    remat).  Returns ``(mean_loss, stage_grads)`` with the grads
-    stacked/sharded exactly like ``stacked_params``.
+    remat).
 
-    ``loss_fn(stage_output, y_microbatch) -> scalar`` (a mean, so
-    microbatches weigh equally).
+    ``head_params`` (optional) are weights the loss applies AFTER the
+    last stage (ln_f / lm head): ``loss_fn(head_params, out, y_mb)``;
+    their gradients come back in ``head_grads``.  Without it,
+    ``loss_fn(out, y_mb)``.  Either way the loss is a mean, so
+    microbatches weigh equally.  ``input_grads`` is dLoss/dx — chain
+    it into the embedding's vjp to train layers before the pipeline.
+    Returns a :class:`PipelineTrainResult`.
     """
     num_stages = mesh.shape[axis]
+    hp_arg = head_params if head_params is not None else {}
+
+    def apply_loss(hp, out, y_mb):
+        if head_params is None:
+            return loss_fn(out, y_mb)
+        return loss_fn(hp, out, y_mb)
+
     if num_stages == 1:
         params = jax.tree.map(lambda p: p[0], stacked_params)
 
-        def whole(p, x):
-            return loss_fn(stage_fn(p, x), y)
+        def whole(p, hp, x):
+            return apply_loss(hp, stage_fn(p, x), y)
 
-        loss, grads = jax.value_and_grad(whole)(params, x)
-        return loss, jax.tree.map(lambda g: g[None], grads)
+        loss, (gp, gh, gx) = jax.value_and_grad(
+            whole, argnums=(0, 1, 2)
+        )(params, hp_arg, x)
+        return PipelineTrainResult(
+            loss=loss,
+            stage_grads=jax.tree.map(lambda g: g[None], gp),
+            head_grads=gh if head_params is not None else None,
+            input_grads=gx,
+        )
 
     b = x.shape[0]
     dp = _dp_size(mesh, batch_axis)
@@ -204,7 +234,7 @@ def pipeline_train_step_1f1b(
     R = 2 * S - 1              # stash ring slots
     T = M + 2 * (S - 1)        # combined schedule length
 
-    def local(params_stage, x_local, y_local):
+    def local(params_stage, hp, x_local, y_local):
         params = jax.tree.map(lambda p: p[0], params_stage)
         mb = x_local.shape[0] // M
         micro_x = x_local.reshape((M, mb) + x_local.shape[1:])
@@ -215,8 +245,8 @@ def pipeline_train_step_1f1b(
         act_shape = (mb,) + x_local.shape[1:]
 
         def step(carry, t):
-            (fwd_recv, bwd_recv, stash, grad_accum,
-             loss_sum) = carry
+            (fwd_recv, bwd_recv, stash, grad_accum, head_accum,
+             dx_buf, loss_sum) = carry
             # ---- forward stream: stage s forwards microbatch t-s
             fwd_mb = t - stage
             fwd_valid = jnp.logical_and(fwd_mb >= 0, fwd_mb < M)
@@ -240,13 +270,17 @@ def pipeline_train_step_1f1b(
             # the total loss is the MEAN over microbatches, so each
             # microbatch's seed carries the 1/M
             y_mb = micro_y[fwd_idx]
-            loss_t, seed = jax.value_and_grad(
-                lambda o: loss_fn(o, y_mb) / M
-            )(out)
+            loss_t, (dhead, seed) = jax.value_and_grad(
+                lambda h, o: apply_loss(h, o, y_mb) / M,
+                argnums=(0, 1),
+            )(hp, out)
             loss_t = loss_t * M
             is_last = stage == S - 1
-            loss_sum = loss_sum + jnp.where(
-                jnp.logical_and(is_last, fwd_valid), loss_t, 0.0
+            turn = jnp.logical_and(is_last, fwd_valid)
+            loss_sum = loss_sum + jnp.where(turn, loss_t, 0.0)
+            head_accum = jax.tree.map(
+                lambda a, g: a + jnp.where(turn, g, 0.0),
+                head_accum, dhead,
             )
             # ---- backward stream: stage s backwards t - 2(S-1) + s
             bwd_mb = t - 2 * (S - 1) + stage
@@ -262,11 +296,20 @@ def pipeline_train_step_1f1b(
                 lambda a, g: a + jnp.where(bwd_valid, g, 0.0),
                 grad_accum, dparams,
             )
+            # stage 0's dx is dLoss/d(pipeline input) for bwd_mb
+            dx_buf = jnp.where(
+                jnp.logical_and(stage == 0, bwd_valid),
+                jax.lax.dynamic_update_index_in_dim(
+                    dx_buf, dx, bwd_idx, axis=0
+                ),
+                dx_buf,
+            )
             # ---- exchanges
             fwd_recv = jax.lax.ppermute(out, axis, fwd_perm)
             bwd_recv = jax.lax.ppermute(dx, axis, bwd_perm)
             return (
-                (fwd_recv, bwd_recv, stash, grad_accum, loss_sum),
+                (fwd_recv, bwd_recv, stash, grad_accum, head_accum,
+                 dx_buf, loss_sum),
                 None,
             )
 
@@ -281,29 +324,51 @@ def pipeline_train_step_1f1b(
             jax.tree.map(
                 lambda p: jnp.zeros_like(p, jnp.float32), params
             ),
+            jax.tree.map(
+                lambda p: jnp.zeros_like(p, jnp.float32), hp
+            ),
+            jnp.zeros((M,) + act_shape, zeros_act.dtype),  # dx_buf
             jnp.zeros((), jnp.float32),
         )
-        (_, _, _, grad_accum, loss_sum), _ = jax.lax.scan(
-            step, init, jnp.arange(T)
+        (_, _, _, grad_accum, head_accum, dx_buf, loss_sum), _ = (
+            jax.lax.scan(step, init, jnp.arange(T))
         )
         # mean over microbatches; only the last stage holds the sum
         loss = jax.lax.psum(loss_sum, axis) / M
+        # head grads live on the last stage, input grads on stage 0:
+        # psum over the pipeline axis replicates them (other stages
+        # hold zeros)
+        head_accum = jax.lax.psum(head_accum, axis)
+        dx_mask = (stage == 0).astype(dx_buf.dtype)
+        dx_local = jax.lax.psum(dx_buf * dx_mask, axis).reshape(
+            (x_local.shape[0],) + x_local.shape[1:]
+        )
         if batch_axis is not None:
             # each data-parallel row saw only its own batch slice:
             # the global loss/gradient is the MEAN over rows (the
-            # out_specs claim replication across the batch axes)
+            # out_specs claim replication across the batch axes);
+            # input grads are per-example and stay batch-sharded but
+            # carry the same 1/dp of the global mean
             loss = jax.lax.pmean(loss, batch_axis)
             grad_accum = jax.lax.pmean(grad_accum, batch_axis)
+            head_accum = jax.lax.pmean(head_accum, batch_axis)
+            dx_local = dx_local / _dp_size(mesh, batch_axis)
         grads = jax.tree.map(lambda g: g[None], grad_accum)
-        return loss, grads
+        return loss, grads, head_accum, dx_local
 
     x_spec = P(batch_axis) if batch_axis is not None else P()
     p_spec = jax.tree.map(lambda _: P(axis), stacked_params)
-    loss, grads = jax.shard_map(
+    hp_spec = jax.tree.map(lambda _: P(), hp_arg)
+    loss, grads, head_grads, input_grads = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(p_spec, x_spec, x_spec),
-        out_specs=(P(), p_spec),
+        in_specs=(p_spec, hp_spec, x_spec, x_spec),
+        out_specs=(P(), p_spec, hp_spec, x_spec),
         check_vma=False,
-    )(stacked_params, x, y)
-    return loss, grads
+    )(stacked_params, hp_arg, x, y)
+    return PipelineTrainResult(
+        loss=loss,
+        stage_grads=grads,
+        head_grads=head_grads if head_params is not None else None,
+        input_grads=input_grads,
+    )

@@ -165,10 +165,11 @@ def test_1f1b_matches_sequential_loss_and_grads(pp_mesh):
         return total / M
 
     l_seq, g_seq = jax.value_and_grad(loss_seq)(stages)
-    l_pipe, g_pipe = pipeline_train_step_1f1b(
+    res = pipeline_train_step_1f1b(
         _stage_fn, loss_fn, stack_stage_params(stages), x, y,
         pp_mesh, num_microbatches=4,
     )
+    l_pipe, g_pipe = res.loss, res.stage_grads
     np.testing.assert_allclose(
         float(l_pipe), float(l_seq), rtol=1e-5
     )
@@ -195,10 +196,11 @@ def test_1f1b_single_stage_degenerates(pp_mesh):
     def loss_fn(out, y_mb):
         return jnp.mean((out - y_mb) ** 2)
 
-    l, g = pipeline_train_step_1f1b(
+    res = pipeline_train_step_1f1b(
         _stage_fn, loss_fn, stack_stage_params(stages), x, y,
         mesh1, num_microbatches=2,
     )
+    l, g = res.loss, res.stage_grads
     l_ref, g_ref = jax.value_and_grad(
         lambda p: loss_fn(_stage_fn(p, x), y)
     )(stages[0])
@@ -283,10 +285,11 @@ def test_1f1b_with_data_parallel_matches_sequential():
         return total / (dp * M)
 
     l_seq, g_seq = jax.value_and_grad(loss_seq)(stages)
-    l_pipe, g_pipe = pipeline_train_step_1f1b(
+    res = pipeline_train_step_1f1b(
         _stage_fn, loss_fn, stack_stage_params(stages), x, y,
         mesh, num_microbatches=2, batch_axis="data",
     )
+    l_pipe, g_pipe = res.loss, res.stage_grads
     np.testing.assert_allclose(
         float(l_pipe), float(l_seq), rtol=1e-5
     )
@@ -295,3 +298,130 @@ def test_1f1b_with_data_parallel_matches_sequential():
             np.asarray(g_pipe["w"][i]), np.asarray(g_seq[i]["w"]),
             atol=1e-4, rtol=1e-4,
         )
+
+
+def test_1f1b_full_lm_segment_with_head_and_embed(pp_mesh):
+    """embed -> pipelined stages -> head trains end-to-end: head
+    grads come from the last stage's turn-around, embed grads chain
+    through the returned input_grads — all exact vs sequential."""
+    from dlrover_tpu.parallel.pipeline import pipeline_train_step_1f1b
+
+    dim, vocab = 8, 16
+    stages = _stages(seed=40)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(41))
+    embed = {"table": jax.random.normal(k1, (vocab, dim)) * 0.5}
+    head = {"w": jax.random.normal(k2, (dim, vocab)) * 0.5}
+    rng = np.random.default_rng(42)
+    tokens = jnp.asarray(rng.integers(0, vocab, (8,)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, vocab, (8,)), jnp.int32)
+
+    def head_loss(hp, out, y_mb):
+        logits = out @ hp["w"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(
+            logp, y_mb[:, None], axis=-1
+        ).mean()
+
+    def full_loss(embed_p, stacked, head_p):
+        M = 4
+        micro_t = tokens.reshape(M, -1)
+        micro_l = labels.reshape(M, -1)
+        total = 0.0
+        for m in range(M):
+            h = embed_p["table"][micro_t[m]]
+            for i in range(4):
+                h = _stage_fn(
+                    jax.tree.map(lambda p: p[i], stacked), h
+                )
+            total = total + head_loss(head_p, h, micro_l[m])
+        return total / M
+
+    stacked = stack_stage_params(stages)
+    l_seq, (ge_seq, gs_seq, gh_seq) = jax.value_and_grad(
+        full_loss, argnums=(0, 1, 2)
+    )(embed, stacked, head)
+
+    # pipelined: embed fwd, pipeline segment, chain embed bwd
+    x_act, embed_vjp = jax.vjp(
+        lambda ep: ep["table"][tokens], embed
+    )
+    res = pipeline_train_step_1f1b(
+        _stage_fn, head_loss, stacked, x_act, labels, pp_mesh,
+        num_microbatches=4, head_params=head,
+    )
+    (ge_pipe,) = embed_vjp(res.input_grads)
+
+    np.testing.assert_allclose(
+        float(res.loss), float(l_seq), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.head_grads["w"]), np.asarray(gh_seq["w"]),
+        atol=1e-5, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ge_pipe["table"]), np.asarray(ge_seq["table"]),
+        atol=1e-5, rtol=1e-4,
+    )
+    for i in range(4):
+        np.testing.assert_allclose(
+            np.asarray(res.stage_grads["w"][i]),
+            np.asarray(gs_seq["w"][i]),
+            atol=1e-4, rtol=1e-4,
+        )
+
+
+def test_1f1b_head_and_input_grads_under_data_parallel():
+    """The hand-derived batch_axis scaling of the two new outputs:
+    head grads pmean over data rows, input grads carry the 1/dp of
+    the global mean — exact vs sequential."""
+    from dlrover_tpu.parallel.pipeline import pipeline_train_step_1f1b
+
+    mesh = build_mesh(MeshConfig(data=2, pipeline=4))
+    dim, vocab = 8, 16
+    stages = _stages(seed=50)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(51))
+    head = {"w": jax.random.normal(k2, (dim, vocab)) * 0.5}
+    x = jax.random.normal(k1, (16, dim))
+    rng = np.random.default_rng(52)
+    labels = jnp.asarray(rng.integers(0, vocab, (16,)), jnp.int32)
+
+    def head_loss(hp, out, y_mb):
+        logits = out @ hp["w"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(
+            logp, y_mb[:, None], axis=-1
+        ).mean()
+
+    def full_loss(xin, stacked, head_p):
+        dpM = 4  # dp=2 rows x M=2 microbatches, in shard order
+        micro_x = xin.reshape(dpM, -1, dim)
+        micro_l = labels.reshape(dpM, -1)
+        total = 0.0
+        for m in range(dpM):
+            h = micro_x[m]
+            for i in range(4):
+                h = _stage_fn(
+                    jax.tree.map(lambda p: p[i], stacked), h
+                )
+            total = total + head_loss(head_p, h, micro_l[m])
+        return total / dpM
+
+    stacked = stack_stage_params(stages)
+    l_seq, (gx_seq, gs_seq, gh_seq) = jax.value_and_grad(
+        full_loss, argnums=(0, 1, 2)
+    )(x, stacked, head)
+    res = pipeline_train_step_1f1b(
+        _stage_fn, head_loss, stacked, x, labels, mesh,
+        num_microbatches=2, batch_axis="data", head_params=head,
+    )
+    np.testing.assert_allclose(
+        float(res.loss), float(l_seq), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.head_grads["w"]), np.asarray(gh_seq["w"]),
+        atol=1e-5, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.input_grads), np.asarray(gx_seq),
+        atol=1e-5, rtol=1e-4,
+    )
