@@ -352,7 +352,7 @@ def pipeline_train_step_1f1b(
             loss = jax.lax.pmean(loss, batch_axis)
             grad_accum = jax.lax.pmean(grad_accum, batch_axis)
             head_accum = jax.lax.pmean(head_accum, batch_axis)
-            dx_local = dx_local / _dp_size(mesh, batch_axis)
+            dx_local = dx_local / dp
         grads = jax.tree.map(lambda g: g[None], grad_accum)
         return loss, grads, head_accum, dx_local
 
