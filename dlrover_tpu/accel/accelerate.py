@@ -204,8 +204,36 @@ def build_from_plan(
 
     import optax
 
-    def step_fn(state: TrainState, batch):
+    use_1f1b = (
+        plan.mesh_config.pipeline > 1
+        and plan.pipeline_schedule == "1f1b"
+    )
+    if use_1f1b:
+        if not hasattr(model, "loss_and_grads_1f1b"):
+            raise ValueError(
+                f"{type(model).__name__} has no loss_and_grads_1f1b "
+                "hook; the 1f1b schedule needs it (use "
+                "schedule='gpipe' for arbitrary models/losses)"
+            )
         if plan.grad_accum > 1:
+            raise ValueError(
+                "grad_accum composes with the gpipe schedule only; "
+                "1f1b already microbatches inside the pipeline"
+            )
+        logger.warning(
+            "pipeline schedule 1f1b: the user loss_fn is bypassed — "
+            "the last stage fuses next-token cross entropy"
+        )
+        note = "1f1b: user loss_fn bypassed (fused next-token CE)"
+        if note not in plan.notes:
+            plan.notes.append(note)
+
+    def step_fn(state: TrainState, batch):
+        if use_1f1b:
+            loss, grads = model.loss_and_grads_1f1b(
+                state.params, batch["x"], batch["y"]
+            )
+        elif plan.grad_accum > 1:
             micro = jax.tree.map(
                 lambda x: x.reshape(
                     (plan.grad_accum, x.shape[0] // plan.grad_accum)

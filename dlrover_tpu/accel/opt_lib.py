@@ -223,9 +223,15 @@ class ModuleReplaceOpt(Optimization):
 
 class PipelineParallelOpt(Optimization):
     """Pipeline stages over the 'pipeline' axis: build_from_plan
-    routes block stacks through ``parallel.pipeline.pipeline_apply``
-    via the model's ``to_pipelined`` hook (reference:
-    pipeline_parallel_optimization.py:56)."""
+    routes block stacks through the model's ``to_pipelined`` hook
+    (reference: pipeline_parallel_optimization.py:56).
+
+    ``schedule="gpipe"`` (default) differentiates the forward
+    pipeline with autodiff — any model/loss.  ``schedule="1f1b"``
+    runs the interleaved schedule (O(stages) activation ring) via the
+    model's ``loss_and_grads_1f1b`` hook, which fuses next-token CE
+    at the last stage — the user loss_fn is bypassed and the batch
+    must carry ``x``/``y`` token arrays."""
 
     name = "pipeline_parallel"
     semiauto = True
@@ -235,9 +241,17 @@ class PipelineParallelOpt(Optimization):
         plan.pipeline_microbatches = int(
             config.get("microbatches", 4)
         )
+        plan.pipeline_schedule = str(
+            config.get("schedule", "gpipe")
+        )
+        if plan.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule "
+                f"{plan.pipeline_schedule!r} (gpipe | 1f1b)"
+            )
         plan.notes.append(
-            f"pipeline x{plan.mesh_config.pipeline} (collective-"
-            f"permute microbatching, "
+            f"pipeline x{plan.mesh_config.pipeline} "
+            f"({plan.pipeline_schedule} schedule, "
             f"{plan.pipeline_microbatches} microbatches)"
         )
         return plan

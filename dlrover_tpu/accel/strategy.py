@@ -30,6 +30,10 @@ class AccelPlan:
     sequence_parallel: str = "none"  # none | ulysses | ring
     grad_accum: int = 1
     pipeline_microbatches: int = 4
+    # "gpipe" (autodiff over the forward pipeline, any loss) or
+    # "1f1b" (interleaved schedule, O(stages) activation ring,
+    # fused next-token CE at the last stage)
+    pipeline_schedule: str = "gpipe"
     fp8: bool = False
     # optimizer states live in host DRAM between steps
     # (reference: adam_offload.py; here via jax memory kinds)

@@ -438,14 +438,11 @@ class PipelinedGPT:
             params, self.num_stages, self.config.num_layers
         )
 
-    def apply(self, variables, tokens: jax.Array) -> jax.Array:
-        from dlrover_tpu.parallel.mesh import get_global_mesh
-        from dlrover_tpu.parallel.pipeline import pipeline_apply
+    # -- shared builders (apply and loss_and_grads_1f1b must stay
+    # numerically identical; keep every dtype cast here) -------------
 
-        pp = variables["params"]
+    def _embedders(self):
         cfg = self.config
-        mesh = get_global_mesh()
-        b, s = tokens.shape
         wte = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
@@ -454,11 +451,18 @@ class PipelinedGPT:
             cfg.max_seq_len, cfg.hidden_dim, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
         )
-        x = wte.apply({"params": pp["embed"]["wte"]}, tokens)
-        x = x + wpe.apply(
-            {"params": pp["embed"]["wpe"]}, jnp.arange(s)[None]
+        return wte, wpe
+
+    def _embed(self, embed_pp, tokens):
+        wte, wpe = self._embedders()
+        s = tokens.shape[1]
+        x = wte.apply({"params": embed_pp["wte"]}, tokens)
+        return x + wpe.apply(
+            {"params": embed_pp["wpe"]}, jnp.arange(s)[None]
         )
 
+    def _make_stage_fn(self):
+        cfg = self.config
         block = Block(cfg)
         if cfg.remat:
             remat_apply = jax.checkpoint(
@@ -475,26 +479,97 @@ class PipelinedGPT:
             h, _ = jax.lax.scan(body, h, stage_params)
             return h
 
-        x = pipeline_apply(
-            stage_fn, pp["blocks"], x, mesh,
-            num_microbatches=self.num_microbatches,
-            batch_axis=self.batch_axis,
-        )
-        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32).apply(
-            {"params": pp["head"]["ln_f"]}, x
-        )
+        return stage_fn
+
+    def _apply_head(self, head_pp, wte_params, h):
+        cfg = self.config
+        h = nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=jnp.float32
+        ).apply({"params": head_pp["ln_f"]}, h)
         if cfg.tie_embeddings:
+            wte, _ = self._embedders()
             logits = wte.apply(
-                {"params": pp["embed"]["wte"]},
-                x.astype(cfg.dtype),
+                {"params": wte_params}, h.astype(cfg.dtype),
                 method="attend",
             )
         else:
             logits = nn.Dense(
                 cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
-            ).apply({"params": pp["head"]["lm_head"]}, x)
+            ).apply({"params": head_pp["lm_head"]}, h)
         return logits.astype(jnp.float32)
+
+    def apply(self, variables, tokens: jax.Array) -> jax.Array:
+        from dlrover_tpu.parallel.mesh import get_global_mesh
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+        pp = variables["params"]
+        mesh = get_global_mesh()
+        x = self._embed(pp["embed"], tokens)
+        x = pipeline_apply(
+            self._make_stage_fn(), pp["blocks"], x, mesh,
+            num_microbatches=self.num_microbatches,
+            batch_axis=self.batch_axis,
+        )
+        return self._apply_head(
+            pp["head"], pp["embed"]["wte"], x
+        )
+
+    def loss_and_grads_1f1b(self, pp, tokens, targets):
+        """Next-token CE through the interleaved (1F1B) schedule.
+
+        The head (ln_f + lm head, incl. the tied embedding) rides the
+        last stage's turn-around; embedding gradients chain through
+        the segment's ``input_grads``; tied-embedding grads from the
+        head and embed paths are summed.  Returns
+        ``(mean_loss, grads)`` with grads in the stage-stacked
+        layout.  (Fixed loss by design: custom losses use the GPipe
+        schedule, ``plan.pipeline_schedule == "gpipe"``.)
+        """
+        from dlrover_tpu.parallel.mesh import get_global_mesh
+        from dlrover_tpu.parallel.pipeline import (
+            pipeline_train_step_1f1b,
+        )
+
+        cfg = self.config
+        mesh = get_global_mesh()
+        x_act, embed_vjp = jax.vjp(
+            lambda ep: self._embed(ep, tokens), pp["embed"]
+        )
+
+        head_params = {"head": pp["head"]}
+        if cfg.tie_embeddings:
+            head_params["wte"] = pp["embed"]["wte"]
+
+        def head_loss(hp, out, y_mb):
+            logits = self._apply_head(
+                hp["head"], hp.get("wte"), out
+            )
+            return cross_entropy_loss(logits, y_mb)
+
+        res = pipeline_train_step_1f1b(
+            self._make_stage_fn(), head_loss, pp["blocks"], x_act,
+            targets, mesh,
+            num_microbatches=self.num_microbatches,
+            batch_axis=self.batch_axis, head_params=head_params,
+        )
+        (d_embed,) = embed_vjp(
+            res.input_grads.astype(x_act.dtype)
+        )
+        grads = {
+            "embed": d_embed,
+            "blocks": res.stage_grads,
+            "head": res.head_grads["head"],
+        }
+        if cfg.tie_embeddings:
+            # the tied table gets gradient from both ends
+            grads["embed"] = {
+                "wte": jax.tree.map(
+                    jnp.add, d_embed["wte"], res.head_grads["wte"]
+                ),
+                "wpe": d_embed["wpe"],
+            }
+        return res.loss, grads
 
 
 def to_pipelined(
