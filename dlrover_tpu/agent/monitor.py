@@ -124,6 +124,10 @@ class TrainingMonitor:
     def _run(self):
         while not self._stopped.wait(self._interval):
             self.report_once()
+        # final flush: a short run (or a loaded machine starving this
+        # thread) can finish before a single interval elapses — the
+        # tail progress must still reach the master's SpeedMonitor
+        self.report_once()
 
     def report_once(self):
         try:
