@@ -394,28 +394,34 @@ def partition_pipeline_params(params, num_stages: int, num_layers: int):
         ),
         stacked,
     )
-    embed = {"wte": params["wte"], "wpe": params["wpe"]}
+    # GPT has wte+wpe; Llama (RoPE) has wte only
+    embed = {
+        k: params[k] for k in ("wte", "wpe") if k in params
+    }
     head = {"ln_f": params["ln_f"]}
     if "lm_head" in params:
         head["lm_head"] = params["lm_head"]
     return {"embed": embed, "blocks": staged, "head": head}
 
 
-class PipelinedGPT:
-    """Model-like wrapper running GPT with pipeline-parallel blocks.
+class PipelinedDecoder:
+    """Base wrapper running a decoder with pipeline-parallel blocks.
 
     Drop-in for the places auto_accelerate touches a model:
-    ``.config``, ``.init_params`` (returns the stage-stacked layout)
-    and ``.apply({"params": pp}, tokens)``.  Constraints: uniform
-    blocks (no MoE interleave) and no nested sequence-parallel
-    attention (both need their own shard_map).
+    ``.config``, ``.init_params`` (returns the stage-stacked layout),
+    ``.apply({"params": pp}, tokens)`` and the 1F1B train hook
+    ``loss_and_grads_1f1b``.  Subclasses provide the three numeric
+    builders (``_embed``, ``_make_stage_fn``, ``_apply_head``) and
+    any family-specific validation.  Constraints shared by all
+    families: uniform blocks (no MoE interleave) and no nested
+    sequence-parallel attention (both need their own shard_map).
     """
 
     def __init__(
-        self, inner: "GPT", num_stages: int, num_microbatches: int,
+        self, inner, num_stages: int, num_microbatches: int,
         batch_axis=("data", "fsdp"),
     ):
-        if inner.config.moe_experts > 0:
+        if getattr(inner.config, "moe_experts", 0) > 0:
             raise ValueError(
                 "pipeline requires uniform blocks; MoE interleave is "
                 "not supported (shard MoE over the expert axis instead)"
@@ -426,45 +432,32 @@ class PipelinedGPT:
                 "sequence-parallel attention cannot nest inside the "
                 "pipeline shard_map"
             )
+        if getattr(inner.config, "decode", False):
+            raise ValueError(
+                "pipeline is a training construct; decode mode "
+                "keeps a KV cache and is not supported"
+            )
         self.inner = inner
         self.config = inner.config
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
         self.batch_axis = batch_axis
 
-    def init_params(self, rng, batch_size: int = 2, seq_len: int = 0):
-        params = self.inner.init_params(rng, batch_size, seq_len)
-        return partition_pipeline_params(
-            params, self.num_stages, self.config.num_layers
-        )
-
-    # -- shared builders (apply and loss_and_grads_1f1b must stay
-    # numerically identical; keep every dtype cast here) -------------
-
-    def _embedders(self):
-        cfg = self.config
-        wte = nn.Embed(
-            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-        )
-        wpe = nn.Embed(
-            cfg.max_seq_len, cfg.hidden_dim, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-        )
-        return wte, wpe
-
+    # numeric builders the family provides (apply and
+    # loss_and_grads_1f1b must stay numerically identical)
     def _embed(self, embed_pp, tokens):
-        wte, wpe = self._embedders()
-        s = tokens.shape[1]
-        x = wte.apply({"params": embed_pp["wte"]}, tokens)
-        return x + wpe.apply(
-            {"params": embed_pp["wpe"]}, jnp.arange(s)[None]
-        )
+        raise NotImplementedError
+
+    def _block(self):
+        """The family's block module (uniform across layers)."""
+        raise NotImplementedError
+
+    def _apply_head(self, head_pp, wte_params, h):
+        raise NotImplementedError
 
     def _make_stage_fn(self):
-        cfg = self.config
-        block = Block(cfg)
-        if cfg.remat:
+        block = self._block()
+        if self.config.remat:
             remat_apply = jax.checkpoint(
                 block.apply, prevent_cse=False
             )
@@ -481,25 +474,13 @@ class PipelinedGPT:
 
         return stage_fn
 
-    def _apply_head(self, head_pp, wte_params, h):
-        cfg = self.config
-        h = nn.LayerNorm(
-            epsilon=cfg.ln_eps, dtype=jnp.float32
-        ).apply({"params": head_pp["ln_f"]}, h)
-        if cfg.tie_embeddings:
-            wte, _ = self._embedders()
-            logits = wte.apply(
-                {"params": wte_params}, h.astype(cfg.dtype),
-                method="attend",
-            )
-        else:
-            logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                param_dtype=cfg.param_dtype,
-            ).apply({"params": head_pp["lm_head"]}, h)
-        return logits.astype(jnp.float32)
+    def init_params(self, rng, batch_size: int = 2, seq_len: int = 0):
+        params = self.inner.init_params(rng, batch_size, seq_len)
+        return partition_pipeline_params(
+            params, self.num_stages, self.config.num_layers
+        )
 
-    def apply(self, variables, tokens: jax.Array) -> jax.Array:
+    def apply(self, variables, tokens):
         from dlrover_tpu.parallel.mesh import get_global_mesh
         from dlrover_tpu.parallel.pipeline import pipeline_apply
 
@@ -512,19 +493,18 @@ class PipelinedGPT:
             batch_axis=self.batch_axis,
         )
         return self._apply_head(
-            pp["head"], pp["embed"]["wte"], x
+            pp["head"], pp["embed"].get("wte"), x
         )
 
     def loss_and_grads_1f1b(self, pp, tokens, targets):
         """Next-token CE through the interleaved (1F1B) schedule.
 
-        The head (ln_f + lm head, incl. the tied embedding) rides the
-        last stage's turn-around; embedding gradients chain through
-        the segment's ``input_grads``; tied-embedding grads from the
-        head and embed paths are summed.  Returns
-        ``(mean_loss, grads)`` with grads in the stage-stacked
-        layout.  (Fixed loss by design: custom losses use the GPipe
-        schedule, ``plan.pipeline_schedule == "gpipe"``.)
+        The head (final norm + lm head, incl. a tied embedding) rides
+        the last stage's turn-around; embedding gradients chain
+        through the segment's ``input_grads``; tied-embedding grads
+        from the head and embed paths are summed.  Returns
+        ``(mean_loss, grads)`` in the stage-stacked layout.  (Fixed
+        loss by design: custom losses use the GPipe schedule.)
         """
         from dlrover_tpu.parallel.mesh import get_global_mesh
         from dlrover_tpu.parallel.pipeline import (
@@ -532,13 +512,14 @@ class PipelinedGPT:
         )
 
         cfg = self.config
+        tied = bool(getattr(cfg, "tie_embeddings", False))
         mesh = get_global_mesh()
         x_act, embed_vjp = jax.vjp(
             lambda ep: self._embed(ep, tokens), pp["embed"]
         )
 
         head_params = {"head": pp["head"]}
-        if cfg.tie_embeddings:
+        if tied:
             head_params["wte"] = pp["embed"]["wte"]
 
         def head_loss(hp, out, y_mb):
@@ -561,15 +542,73 @@ class PipelinedGPT:
             "blocks": res.stage_grads,
             "head": res.head_grads["head"],
         }
-        if cfg.tie_embeddings:
+        if tied:
             # the tied table gets gradient from both ends
-            grads["embed"] = {
-                "wte": jax.tree.map(
+            grads["embed"] = dict(
+                d_embed,
+                wte=jax.tree.map(
                     jnp.add, d_embed["wte"], res.head_grads["wte"]
                 ),
-                "wpe": d_embed["wpe"],
-            }
+            )
         return res.loss, grads
+
+
+class PipelinedGPT(PipelinedDecoder):
+    """GPT family: wte+wpe embed, LayerNorm head, optional tied
+    embeddings."""
+
+    def _embedders(self):
+        cfg = self.config
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        )
+        wpe = nn.Embed(
+            cfg.max_seq_len, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        )
+        return wte, wpe
+
+    def _embed(self, embed_pp, tokens):
+        wte, wpe = self._embedders()
+        s = tokens.shape[1]
+        x = wte.apply({"params": embed_pp["wte"]}, tokens)
+        return x + wpe.apply(
+            {"params": embed_pp["wpe"]}, jnp.arange(s)[None]
+        )
+
+    def __init__(self, inner, num_stages, num_microbatches,
+                 batch_axis=("data", "fsdp")):
+        if inner.config.head != "lm":
+            raise ValueError(
+                f"pipeline supports the lm head only, not "
+                f"{inner.config.head!r} (value heads would be "
+                "silently dropped by the stage partitioner)"
+            )
+        super().__init__(
+            inner, num_stages, num_microbatches, batch_axis
+        )
+
+    def _block(self):
+        return Block(self.config)
+
+    def _apply_head(self, head_pp, wte_params, h):
+        cfg = self.config
+        h = nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=jnp.float32
+        ).apply({"params": head_pp["ln_f"]}, h)
+        if cfg.tie_embeddings:
+            wte, _ = self._embedders()
+            logits = wte.apply(
+                {"params": wte_params}, h.astype(cfg.dtype),
+                method="attend",
+            )
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+            ).apply({"params": head_pp["lm_head"]}, h)
+        return logits.astype(jnp.float32)
 
 
 def to_pipelined(

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.models.gpt import (
+    PipelinedDecoder,
     cached_decode_attention,
     get_attention_fn,
 )
@@ -276,3 +277,45 @@ class Llama(nn.Module):
         seq_len = seq_len or min(self.config.max_seq_len, 128)
         tokens = jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
         return self.init(rng, tokens)["params"]
+
+
+class PipelinedLlama(PipelinedDecoder):
+    """Llama family over the pipeline axis: RoPE blocks need no
+    position embedding at the boundary (positions are absolute inside
+    each block's attention), RMSNorm + untied lm head."""
+
+    def _embed(self, embed_pp, tokens):
+        cfg = self.config
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        )
+        return wte.apply({"params": embed_pp["wte"]}, tokens)
+
+    def _block(self):
+        return LlamaBlock(self.config)
+
+    def _apply_head(self, head_pp, wte_params, h):
+        cfg = self.config
+        h = RMSNorm(cfg.rms_eps).apply(
+            {"params": head_pp["ln_f"]}, h
+        )
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        ).apply({"params": head_pp["lm_head"]}, h)
+        return logits.astype(jnp.float32)
+
+
+def to_pipelined(
+    model: "Llama", num_stages: int, num_microbatches: int,
+    batch_axis=("data", "fsdp"),
+) -> PipelinedLlama:
+    """auto_accelerate protocol hook (build_from_plan calls this when
+    the plan's mesh has pipeline > 1)."""
+    return PipelinedLlama(
+        model, num_stages, num_microbatches, batch_axis
+    )
+
+
+Llama.to_pipelined = to_pipelined
