@@ -470,3 +470,17 @@ def test_auto_accelerate_1f1b_schedule_matches_gpipe():
     l_1f1b = run("1f1b")
     assert l_1f1b[-1] < l_1f1b[0], l_1f1b
     np.testing.assert_allclose(l_1f1b, l_gpipe, rtol=2e-4)
+
+
+def test_pipelined_guards_reject_unsupported_configs():
+    from dlrover_tpu.models.gpt import GPT, GPTConfig
+    from dlrover_tpu.models.llama import Llama, LlamaConfig
+
+    with pytest.raises(ValueError, match="decode"):
+        GPT(GPTConfig.tiny(decode=True)).to_pipelined(2, 2)
+    with pytest.raises(ValueError, match="decode"):
+        Llama(LlamaConfig.tiny(decode=True)).to_pipelined(2, 2)
+    with pytest.raises(ValueError, match="lm head"):
+        GPT(GPTConfig.tiny(head="value")).to_pipelined(2, 2)
+    with pytest.raises(ValueError, match="MoE"):
+        GPT(GPTConfig.tiny(moe_experts=2)).to_pipelined(2, 2)
