@@ -174,12 +174,16 @@ class LlamaAttention(nn.Module):
             positions = jnp.arange(s)
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
-            if cfg.num_kv_heads != cfg.num_heads:
+            attn_fn = get_attention_fn(cfg.attention_impl)
+            if cfg.num_kv_heads != cfg.num_heads and not getattr(
+                attn_fn, "gqa_aware", False
+            ):
+                # the Pallas flash kernel is GQA-aware (reads each kv
+                # head once per group via its index maps); other
+                # impls need the materialized repeat
                 group = cfg.num_heads // cfg.num_kv_heads
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
-
-            attn_fn = get_attention_fn(cfg.attention_impl)
             out = attn_fn(q, k, v, dtype=cfg.dtype)
         out = out.reshape(b, s, cfg.num_heads * hd)
         return nn.Dense(

@@ -115,13 +115,17 @@ def _fwd_kernel(
 
 
 def _fwd(
-    q, k, v, scale: float, causal: bool, block_q: int, block_k: int
+    q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
+    group: int = 1,
 ):
     bh, seq, d = q.shape
     num_q = seq // block_q
     num_kv = seq // block_k
     grid = (bh, num_q, num_kv)
 
+    # GQA: k/v carry bh//group rows; `group` consecutive q heads read
+    # the same kv row through the index map — the repeated kv tensor
+    # never materializes in HBM
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, block_q=block_q,
@@ -130,8 +134,14 @@ def _fwd(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda b, i, j: (b // group, j, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda b, i, j: (b // group, j, 0),
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -286,7 +296,7 @@ def _bwd_dkv_kernel(
 
 
 def _bwd(
-    scale, causal, block_q, block_k, residuals, dout
+    scale, causal, block_q, block_k, group, residuals, dout
 ):
     q, k, v, out, lse = residuals
     bh, seq, d = q.shape
@@ -305,8 +315,14 @@ def _bwd(
         grid=(bh, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda b, i, j: (b // group, j, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda b, i, j: (b // group, j, 0),
+            ),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -327,8 +343,14 @@ def _bwd(
         grid=(bh, num_kv, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda b, j, i: (b // group, j, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda b, j, i: (b // group, j, 0),
+            ),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
@@ -347,6 +369,15 @@ def _bwd(
         ],
         interpret=_interpret(),
     )(q, k, v, dout, lse, delta)
+    if group > 1:
+        # per-q-head kv grads -> per-kv-head (rows sharing a kv head
+        # are the `group` consecutive q heads)
+        dk = dk.reshape(bh // group, group, seq, d).astype(
+            jnp.float32
+        ).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(bh // group, group, seq, d).astype(
+            jnp.float32
+        ).sum(axis=1).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -356,20 +387,24 @@ def _bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
-def _flash_mha(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_mha(q, k, v, scale, causal, block_q, block_k, group=1):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, group)
     return out
 
 
-def _flash_mha_fwd(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_mha_fwd(q, k, v, scale, causal, block_q, block_k,
+                   group=1):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, group)
     return out, (q, k, v, out, lse)
 
 
-def _flash_mha_bwd(scale, causal, block_q, block_k, residuals, dout):
-    return _bwd(scale, causal, block_q, block_k, residuals, dout)
+def _flash_mha_bwd(scale, causal, block_q, block_k, group,
+                   residuals, dout):
+    return _bwd(
+        scale, causal, block_q, block_k, group, residuals, dout
+    )
 
 
 _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
@@ -402,8 +437,26 @@ def flash_attention(
     Drop-in for :func:`dlrover_tpu.models.gpt.xla_causal_attention`.
     Sequence length must be divisible by the block sizes (the caller
     pads; GPT training shapes are powers of two).
+
+    GQA: ``k``/``v`` may carry fewer heads than ``q`` (``kv_heads``
+    dividing ``heads``, kv-head-major q layout as in the Llama
+    family); the forward and dq kernels read each kv head once per
+    group through their index maps, so the repeated kv tensor never
+    materializes there.  The dkv backward still emits per-q-head
+    gradients (a transient group-x temporary) before the group
+    reduction.
     """
     b, s, h, d = q.shape
+    kvh = k.shape[2]
+    if v.shape[2] != kvh:
+        raise ValueError(
+            f"k has {kvh} heads but v has {v.shape[2]}"
+        )
+    if h % kvh:
+        raise ValueError(
+            f"q heads {h} not a multiple of kv heads {kvh}"
+        )
+    group = h // kvh
     scale = scale if scale is not None else d**-0.5
     block_q = _fit_block(s, block_q)
     block_k = _fit_block(s, block_k)
@@ -414,12 +467,20 @@ def flash_attention(
         )
 
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        hh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, s, d)
 
     out = _flash_mha(
-        fold(q), fold(k), fold(v), scale, causal, block_q, block_k
+        fold(q), fold(k), fold(v), scale, causal, block_q, block_k,
+        group,
     )
     out = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     if dtype is not None:
         out = out.astype(dtype)
     return out
+
+
+# dispatch layers (LlamaAttention) key on this instead of the impl
+# string: only the plain flash path accepts kv_heads < heads
+# (ulysses all-to-alls heads across devices and needs the repeat)
+flash_attention.gqa_aware = True

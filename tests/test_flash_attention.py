@@ -121,3 +121,61 @@ def test_flash_attention_head_dim_128():
         lambda q: flash_attention(q, k, v).astype(jnp.float32).sum()
     )(q)
     assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_flash_gqa_matches_repeated_kv():
+    """GQA path: k/v with fewer heads through the index maps must
+    match the materialized-repeat MHA computation, forward and
+    gradients (q, k AND v)."""
+    b, s, h, kvh, d = 2, 256, 8, 2, 64
+    group = h // kvh
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kvh, d), jnp.float32)
+    v = jax.random.normal(kv_, (b, s, kvh, d), jnp.float32)
+    # kv-head-major repeat (Llama layout: head = kvh_idx*group + g)
+    k_rep = jnp.repeat(k, group, axis=2)
+    v_rep = jnp.repeat(v, group, axis=2)
+
+    # small blocks so the grid is multi-block and the //group index
+    # map is exercised across kv blocks (incl. causal skipping)
+    out_gqa = flash_attention(q, k, v, block_q=64, block_k=64)
+    out_rep = flash_attention(q, k_rep, v_rep, block_q=64, block_k=64)
+    np.testing.assert_allclose(
+        np.asarray(out_gqa), np.asarray(out_rep), atol=1e-5,
+        rtol=1e-5,
+    )
+
+    def loss_gqa(q, k, v):
+        return (
+            flash_attention(q, k, v, block_q=64, block_k=64) ** 2
+        ).sum()
+
+    def loss_rep(q, k, v):
+        return (
+            flash_attention(
+                q, jnp.repeat(k, group, axis=2),
+                jnp.repeat(v, group, axis=2),
+                block_q=64, block_k=64,
+            ) ** 2
+        ).sum()
+
+    g_gqa = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    g_rep = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_gqa, g_rep):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=2e-4, rtol=2e-4,
+        )
+
+
+def test_flash_gqa_rejects_nondivisible_heads():
+    q = jnp.zeros((1, 128, 6, 64))
+    k = jnp.zeros((1, 128, 4, 64))
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k, k)
+    # k/v head mismatch must be rejected, not silently mis-indexed
+    q8 = jnp.zeros((2, 128, 8, 64))
+    k2 = jnp.zeros((2, 128, 2, 64))
+    v8 = jnp.zeros((2, 128, 8, 64))
+    with pytest.raises(ValueError, match="heads"):
+        flash_attention(q8, k2, v8)
