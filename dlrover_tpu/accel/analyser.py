@@ -80,6 +80,67 @@ def analyse(context) -> AnalysisResult:
     )
 
 
+# Planning bandwidths (bytes/s per device, conservative): v5e ICI
+# ~45 GB/s/link usable; DCN between slices ~100 Gbps/host shared ->
+# ~3 GB/s/chip class.  Exact numbers matter less than the ~15x gap:
+# the model only has to ORDER candidates, and the gap is what makes
+# cross-slice fsdp/tensor prohibitive (SURVEY §5 ICI-vs-DCN).
+ICI_BW = 45e9
+DCN_BW = 3e9
+
+
+def comm_cost_s(
+    analysis: AnalysisResult,
+    data: int,
+    fsdp: int,
+    tensor: int,
+    num_slices: int = 1,
+    grad_accum: int = 1,
+    sequence: int = 1,
+    expert: int = 1,
+) -> float:
+    """Per-step collective time (seconds) under the hybrid-mesh
+    placement rule (``parallel.mesh.DCN_AXES``): ``data`` may span
+    the DCN, ``fsdp``/``tensor`` ride ICI.  Ring-collective model:
+    allreduce moves ``2(n-1)/n x bytes``, all-gather/reduce-scatter
+    ``(n-1)/n x bytes`` each.
+
+    This is the DCN-vs-ICI term the XLA compile-only cost model
+    cannot see when compiling for a virtual flat mesh — added on top
+    of ``estimate_plan`` by the strategy search (VERDICT r2 missing
+    #3)."""
+    grad_bytes = analysis.param_bytes
+    t = 0.0
+    if data > 1:
+        # gradient allreduce once per optimizer step; spans DCN when
+        # slices tile the data axis
+        bw = DCN_BW if num_slices > 1 else ICI_BW
+        t += 2 * (data - 1) / data * grad_bytes / bw / grad_accum
+    if fsdp > 1:
+        # all-gather params (fwd+bwd) + reduce-scatter grads, on ICI
+        t += 3 * (fsdp - 1) / fsdp * grad_bytes / ICI_BW
+    if tensor > 1:
+        # activation allreduces: 2 per layer fwd+bwd ~ 4x activation
+        # bytes; coarse but orders tp=2 vs tp=8 correctly
+        t += 4 * (tensor - 1) / tensor * (
+            analysis.batch_bytes * 2.0
+        ) / ICI_BW
+    if sequence > 1:
+        # Ulysses/ring: 2 all-to-alls fwd + 2 bwd over activations —
+        # the sp/ep variants must not get a free pass vs the tp
+        # variant of the same factorization (they shard the same
+        # model-dim budget)
+        t += 4 * (sequence - 1) / sequence * (
+            analysis.batch_bytes * 2.0
+        ) / ICI_BW
+    if expert > 1:
+        # MoE dispatch/combine all-to-alls, fwd + bwd
+        t += 4 * (expert - 1) / expert * (
+            analysis.batch_bytes * 2.0
+        ) / ICI_BW
+    return t
+
+
 def fits_in_hbm(
     analysis: AnalysisResult, fsdp_size: int, tensor_size: int,
     remat: bool, activation_factor: float = 4.0,

@@ -101,13 +101,21 @@ def generate_candidates(
     grad_accums: Tuple[int, ...] = (1, 2),
     max_tensor: int = 8,
     long_seq_threshold: int = 8192,
+    num_slices: int = 1,
+    analysis=None,
 ) -> List[Candidate]:
     """Combination generation pruned by the memory model (reference:
     combination_sg.py).  Model-aware axes: MoE configs get
     expert-parallel variants, long sequences get ring
     sequence-parallel variants (the tensor slot of each factorization
-    is repurposed — both shard the same "model" dimension budget)."""
-    analysis = analyse(context)
+    is repurposed — both shard the same "model" dimension budget).
+
+    ``num_slices`` > 1 (multi-slice topology): only factorizations
+    whose DCN-tolerant ``data`` axis absorbs the slice count survive
+    — fsdp/tensor/sequence/expert collectives must never cross the
+    DCN (``parallel.mesh.DCN_AXES`` placement rule)."""
+    if analysis is None:
+        analysis = analyse(context)
     batch = max(1, analysis.batch_size)
     model_cfg = getattr(context.model, "config", None)
     is_moe = bool(getattr(model_cfg, "moe_experts", 0))
@@ -116,6 +124,9 @@ def generate_candidates(
     seen = set()
     for data, fsdp, tensor in mesh_factorizations(num_devices):
         if tensor > max_tensor:
+            continue
+        if num_slices > 1 and data % num_slices:
+            # ICI-hungry axes would straddle slices
             continue
         # the third factor is a "model-dim shard" budget: try it as
         # tensor parallel, and — when the model calls for it — as
@@ -186,6 +197,7 @@ def search_strategy(
     grad_accums: Tuple[int, ...] = (1, 2),
     seed: int = 0,
     rank_mode: str = "profile",
+    num_slices: int = 1,
 ) -> SearchResult:
     """Generate, prune, and rank; BO picks what to measure when
     candidates exceed the budget (reference: bayes_opt_sg.py).
@@ -204,7 +216,11 @@ def search_strategy(
     if rank_mode not in ("profile", "cost_model"):
         raise ValueError(f"unknown rank_mode {rank_mode!r}")
     lib = OptimizationLibrary()
-    cands = generate_candidates(context, num_devices, grad_accums)
+    analysis = analyse(context)  # one pass, shared with the DCN term
+    cands = generate_candidates(
+        context, num_devices, grad_accums, num_slices=num_slices,
+        analysis=analysis,
+    )
     logger.info(
         "strategy search: %d candidates after HBM pruning: %s",
         len(cands), [c.describe() for c in cands],
@@ -213,11 +229,25 @@ def search_strategy(
     def evaluate(cand: Candidate) -> float:
         plan = lib.apply_strategy(cand.strategy, context)
         plan.grad_accum = cand.grad_accum
+        if num_slices > 1:
+            plan.mesh_config.num_slices = num_slices
         if rank_mode == "cost_model":
             result = estimate_plan(plan, context, devices=devices)
             cand.step_time_s = (
                 result.est_step_time_s if result.ok else float("inf")
             )
+            if result.ok:
+                # DCN-vs-ICI collective term the compile-only cost
+                # model cannot see on a virtual flat mesh
+                from dlrover_tpu.accel.analyser import comm_cost_s
+
+                cand.step_time_s += comm_cost_s(
+                    analysis, cand.data, cand.fsdp, cand.tensor,
+                    num_slices=num_slices,
+                    grad_accum=cand.grad_accum,
+                    sequence=cand.sequence,
+                    expert=cand.expert,
+                )
         else:
             result = profile_plan(plan, context, devices=devices)
             cand.step_time_s = (

@@ -316,10 +316,15 @@ class MasterClient:
             )
         )
 
-    @retry_request
-    def report_node_event(
+    def report_node_event_once(
         self, event_type: str, status: str, exit_reason: str = ""
     ) -> bool:
+        """Single-shot (unretried) variant for advisory reports whose
+        retry could deliver duplicates: the preemption notice is a
+        latency optimization — the pod watcher is the durable fallback
+        when the report is lost, so re-sending buys nothing and a
+        success-with-lost-ack retry would feed the master the same
+        death twice."""
         return self._client.report(
             msg.NodeEventReport(
                 node_id=self._node_id,
@@ -329,6 +334,8 @@ class MasterClient:
                 exit_reason=exit_reason,
             )
         )
+
+    report_node_event = retry_request(report_node_event_once)
 
     @retry_request
     def ready_to_exit(self, reason: str = "") -> bool:

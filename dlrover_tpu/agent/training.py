@@ -307,7 +307,10 @@ class ElasticTrainingAgent:
 
         def report():
             try:
-                self._client.report_node_event(
+                # single-shot: the watcher path is the durable fallback
+                # if this report is lost; a retried send could deliver
+                # the same preemption twice (ADVICE r2)
+                self._client.report_node_event_once(
                     event_type="preemption_notice",
                     status=NodeStatus.FAILED,
                     exit_reason=NodeExitReason.PREEMPTED,

@@ -79,6 +79,10 @@ class CheckpointEngine:
         self._writer_lock = threading.Lock()
         self._jit_copy = None
         self._last_async_error: Optional[Exception] = None
+        # phase breakdown of the last completed shm save (lock wait,
+        # device->host fetch, memcpy) — surfaced so benches report the
+        # dominant term instead of burying it in logs (VERDICT r2)
+        self.last_save_phases: Dict[str, float] = {}
         self._local_rank = (
             local_rank if local_rank is not None
             else env_utils.get_local_rank()
@@ -179,7 +183,9 @@ class CheckpointEngine:
         # ranks that never persist to storage; without an agent there
         # is no concurrent reader and no lock server to talk to
         locked = False
+        lock_wait = 0.0
         if self._agent_lock_available():
+            t0 = time.perf_counter()
             if not self._shm_lock.acquire(
                 blocking=block_lock, timeout=600.0
             ):
@@ -188,6 +194,7 @@ class CheckpointEngine:
                     step,
                 )
                 return False
+            lock_wait = time.perf_counter() - t0
             locked = True
         try:
             config = CheckpointConfig(
@@ -200,9 +207,16 @@ class CheckpointEngine:
             start = time.time()
             self._shm_handler.save_state_dict(state_dict, config)
             self._cached_step = step
+            phases = dict(self._shm_handler.last_save_phases)
+            phases["lock_wait_s"] = round(lock_wait, 3)
+            phases["total_s"] = round(time.time() - start + lock_wait, 3)
+            self.last_save_phases = phases
             logger.info(
-                "rank %s shm save of step %s took %.3fs",
+                "rank %s shm save of step %s took %.3fs "
+                "(lock %.2fs, d2h fetch %.2fs, memcpy %.2fs)",
                 self._rank, step, time.time() - start,
+                lock_wait, phases.get("fetch_s", 0.0),
+                phases.get("memcpy_s", 0.0),
             )
             return True
         finally:

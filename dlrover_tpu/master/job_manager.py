@@ -70,7 +70,10 @@ class JobManager:
         node = self.add_node(node_type, node_id)
         old = node.status
         if old == status:
-            return
+            # no transition: callers (e.g. the distributed manager's
+            # relaunch path) must not re-handle an already-seen death
+            # delivered again by a @retry_request'd agent report
+            return False
         node.update_status(status)
         if exit_reason:
             node.exit_reason = exit_reason
@@ -88,6 +91,7 @@ class JobManager:
             exit_reason,
         )
         self._fire(NodeEvent(event_type, node))
+        return True
 
     def _fire(self, event: NodeEvent):
         for cb in self._event_callbacks:

@@ -136,9 +136,16 @@ class DistributedJobManager(JobManager):
         VM die.  Idempotent with the later watcher event:
         ``_relaunch_node`` marks the node released, which
         ``_should_relaunch`` rejects on the second trigger."""
-        super().update_node_status(
+        changed = super().update_node_status(
             node_id, node_type, status, exit_reason
         )
+        if not changed:
+            # a retried agent report (or the watcher re-delivering the
+            # same terminal status) must not re-enter the exit handler:
+            # a node whose relaunch budget is exactly consumed would
+            # otherwise hit the job-exit branch on the duplicate even
+            # though its replacement already launched
+            return
         node = self.get_node(node_id)
         if node is not None and node.status in (
             NodeStatus.FAILED, NodeStatus.DELETED
@@ -147,6 +154,7 @@ class DistributedJobManager(JobManager):
 
     def _handle_node_exit(self, node: Node):
         with self._relaunch_lock:
+            already_handled = node.is_released
             relaunch = self._should_relaunch(node)
             if relaunch:
                 # claim under the lock: a concurrent second delivery
@@ -155,7 +163,13 @@ class DistributedJobManager(JobManager):
                 node.is_released = True
         if relaunch:
             self._relaunch_node(node)
-        elif node.critical or self._all_relaunches_exhausted():
+        elif not already_handled and (
+            node.critical or self._all_relaunches_exhausted()
+        ):
+            # only the delivery that first handled this death may abort
+            # the job: a duplicate arriving after the relaunch claimed
+            # the node would see an exhausted budget and abort a job
+            # whose replacement is already running
             self.job_exit_reason = node.exit_reason or "node_failed"
 
     def _should_relaunch(self, node: Node) -> bool:

@@ -38,10 +38,20 @@ class QMoment(NamedTuple):
     scales: jax.Array   # f32 [rows, 1]
 
 
+# nu-storage domain tag carried inside the optimizer state (and hence
+# inside every checkpoint of it).  Value 1 = sqrt-domain nu (current).
+# Pre-tag checkpoints (linear-domain nu) have NO nu_domain leaf, so a
+# generic pytree restore rejects them with a missing-leaf error instead
+# of silently reinterpreting linear q*scale as sqrt(nu) (ADVICE r2);
+# ``migrate_qadamw_state_v0`` upgrades them explicitly.
+NU_DOMAIN_SQRT_V1 = 1
+
+
 class QAdamWState(NamedTuple):
     count: jax.Array
     mu: optax.Updates   # pytree of QMoment
     nu: optax.Updates
+    nu_domain: jax.Array  # int32 scalar, see NU_DOMAIN_SQRT_V1
 
 
 def _quant(x, block):
@@ -87,6 +97,7 @@ def q_adamw(
                 ),
                 params,
             ),
+            nu_domain=jnp.asarray(NU_DOMAIN_SQRT_V1, jnp.int32),
         )
 
     def update_fn(grads, state, params=None):
@@ -129,7 +140,9 @@ def q_adamw(
         updates = treedef.unflatten([o[0] for o in out])
         mu = treedef.unflatten([o[1] for o in out])
         nu = treedef.unflatten([o[2] for o in out])
-        return updates, QAdamWState(count=count, mu=mu, nu=nu)
+        return updates, QAdamWState(
+            count=count, mu=mu, nu=nu, nu_domain=state.nu_domain
+        )
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -180,6 +193,7 @@ def _q_adamw_4bit(
             nu=jax.tree.map(
                 lambda p: q4u(jnp.zeros_like(p, jnp.float32)), params
             ),
+            nu_domain=jnp.asarray(NU_DOMAIN_SQRT_V1, jnp.int32),
         )
 
     def update_fn(grads, state, params=None):
@@ -213,7 +227,38 @@ def _q_adamw_4bit(
                 count=count,
                 mu=treedef.unflatten([o[1] for o in out]),
                 nu=treedef.unflatten([o[2] for o in out]),
+                nu_domain=state.nu_domain,
             ),
         )
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def migrate_qadamw_state_v0(old_state, block_size: int = DEFAULT_BLOCK):
+    """Upgrade a pre-``nu_domain`` 8-bit QAdamWState (nu stored
+    LINEAR: ``value = q * scale``) to the current sqrt-domain format.
+
+    ``old_state`` is a ``(count, mu, nu)`` tuple/namedtuple of the old
+    layout.  nu is dequantized with the linear codec and requantized in
+    the sqrt domain (the format the fused kernel reads)."""
+    count, mu, nu = old_state[0], old_state[1], old_state[2]
+
+    def requant(qm):
+        rows = qm.values.shape[0]
+        lin = dequantize_blockwise(
+            qm.values, qm.scales, (rows, block_size)
+        )
+        y = jnp.sqrt(jnp.maximum(lin, 0.0))
+        s = jnp.maximum(
+            jnp.max(y, axis=-1, keepdims=True) / 127.0, 1e-12
+        )
+        q = jnp.clip(jnp.round(y / s), 0, 127).astype(jnp.int8)
+        return QMoment(values=q, scales=s)
+
+    new_nu = jax.tree.map(
+        requant, nu, is_leaf=lambda x: isinstance(x, QMoment)
+    )
+    return QAdamWState(
+        count=count, mu=mu, nu=new_nu,
+        nu_domain=jnp.asarray(NU_DOMAIN_SQRT_V1, jnp.int32),
+    )

@@ -19,6 +19,7 @@ On a TPU pod slice the mesh should be laid out so ``tensor`` and
 ordering.
 """
 
+import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -26,10 +27,23 @@ import numpy as np
 
 AXES = ("data", "fsdp", "tensor", "sequence", "expert", "pipeline")
 
+# Multi-slice placement rule (SURVEY §5 ICI-vs-DCN mapping; reference
+# handles multi-node hierarchies in create_parallel_group,
+# atorch/distributed/distributed.py:323): bandwidth-hungry collectives
+# (fsdp all-gather/reduce-scatter, tensor allreduce, sequence
+# all-to-all, expert all-to-all) must stay inside a slice on ICI;
+# only bandwidth-light axes may span the DCN between slices — data
+# (one gradient allreduce per step, overlappable) and pipeline
+# (p2p activations, O(activation) per microbatch).
+DCN_AXES = ("data", "pipeline")
+ICI_AXES = ("fsdp", "tensor", "sequence", "expert")
+
 
 @dataclass
 class MeshConfig:
-    """Logical mesh shape; -1 on ``data`` absorbs remaining devices."""
+    """Logical mesh shape; -1 on ``data`` absorbs remaining devices.
+    ``num_slices`` = 0 auto-detects from the devices' ``slice_index``;
+    >1 forces a hybrid ICI/DCN mesh (see :func:`build_mesh`)."""
 
     data: int = -1
     fsdp: int = 1
@@ -37,6 +51,7 @@ class MeshConfig:
     sequence: int = 1
     expert: int = 1
     pipeline: int = 1
+    num_slices: int = 0
 
     def axis_sizes(self, num_devices: int) -> Dict[str, int]:
         sizes = {
@@ -71,12 +86,88 @@ class MeshConfig:
 
     @classmethod
     def from_dict(cls, d: Dict[str, int]) -> "MeshConfig":
-        return cls(**{k: v for k, v in d.items() if k in AXES})
+        return cls(**{
+            k: v for k, v in d.items()
+            if k in AXES or k == "num_slices"
+        })
+
+
+def detect_num_slices(devices: Sequence) -> int:
+    """Distinct TPU slices in the device set (``slice_index`` is set by
+    the runtime on multi-slice topologies; CPU/single-slice -> 1)."""
+    ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    return len(ids)
+
+
+def group_devices_by_slice(
+    devices: Sequence, num_slices: int
+) -> List[List]:
+    """Slice-membership groups, equal-sized.  Real multi-slice device
+    sets carry ``slice_index``; fabricated test sets (CPU) are split
+    contiguously — process_index first so a slice never straddles
+    hosts."""
+    if len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into "
+            f"{num_slices} slices"
+        )
+    have_idx = {
+        getattr(d, "slice_index", None) for d in devices
+    } - {None}
+    if have_idx and len(have_idx) != num_slices:
+        # real topology information contradicts the request: a
+        # contiguous fallback would let ICI-only axes straddle
+        # physical slice boundaries over DCN — refuse instead
+        raise ValueError(
+            f"devices report {len(have_idx)} physical slices "
+            f"({sorted(have_idx)}) but num_slices={num_slices}"
+        )
+    if len(have_idx) == num_slices:
+        groups: Dict[int, List] = {}
+        for d in devices:
+            groups.setdefault(d.slice_index, []).append(d)
+        per = len(devices) // num_slices
+        out = [groups[k] for k in sorted(groups)]
+        if any(len(g) != per for g in out):
+            raise ValueError(
+                f"uneven slices: {[len(g) for g in out]}"
+            )
+        return out
+    per = len(devices) // num_slices
+    ordered = sorted(
+        devices, key=lambda d: (getattr(d, "process_index", 0),
+                                getattr(d, "id", 0)),
+    )
+    return [ordered[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+def split_axes_dcn_ici(
+    sizes: Dict[str, int], num_slices: int
+) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """Factor each axis into (dcn, ici) extents: ``num_slices`` is
+    absorbed by the DCN-tolerant axes (data first, then pipeline);
+    ICI axes must fit inside one slice."""
+    dcn = {a: 1 for a in AXES}
+    remaining = num_slices
+    for a in DCN_AXES:
+        g = math.gcd(sizes[a], remaining)
+        dcn[a] = g
+        remaining //= g
+    if remaining != 1:
+        raise ValueError(
+            f"cannot place {num_slices} slices on the DCN axes "
+            f"{DCN_AXES} of mesh {sizes}: data*pipeline="
+            f"{sizes['data'] * sizes['pipeline']} does not absorb it "
+            f"(bandwidth-hungry axes {ICI_AXES} may not span DCN)"
+        )
+    ici = {a: sizes[a] // dcn[a] for a in AXES}
+    return dcn, ici
 
 
 def build_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence] = None,
+    num_slices: Optional[int] = None,
 ):
     """Build a Mesh over the global device set.
 
@@ -84,23 +175,70 @@ def build_mesh(
     the physical ICI torus (fastest-varying axes get the tightest
     rings) — the TPU analog of the reference's switch-topology-aware
     rank sorting (``master/elastic_training/net_topology.py``).
+
+    Multi-slice (``num_slices`` > 1, auto-detected from the devices'
+    ``slice_index`` when not given): a hybrid mesh is assembled with
+    ``data``/``pipeline`` spanning the DCN between slices and
+    ``fsdp/tensor/sequence/expert`` confined to each slice's ICI —
+    the TPU analog of the reference's intra-node NCCL x inter-node
+    hierarchy (``atorch/distributed/distributed.py:323``).
     """
     import jax
-    from jax.experimental import mesh_utils
     from jax.sharding import Mesh
 
     config = config or MeshConfig()
     devices = list(devices) if devices is not None else jax.devices()
+    if num_slices is None:
+        num_slices = (
+            config.num_slices or detect_num_slices(devices)
+        )
     sizes = config.axis_sizes(len(devices))
+    if num_slices > 1:
+        return Mesh(
+            _hybrid_device_array(sizes, devices, num_slices), AXES
+        )
     shape = tuple(sizes[a] for a in AXES)
+    return Mesh(_ici_device_array(shape, devices), AXES)
+
+
+def _ici_device_array(shape: Tuple[int, ...], devices: Sequence):
+    from jax.experimental import mesh_utils
+
     try:
-        dev_array = mesh_utils.create_device_mesh(
+        return mesh_utils.create_device_mesh(
             shape, devices=np.asarray(devices)
         )
     except (ValueError, AssertionError):
         # non-TPU or odd shapes: plain reshape keeps semantics
-        dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, AXES)
+        return np.asarray(devices).reshape(shape)
+
+
+def _hybrid_device_array(
+    sizes: Dict[str, int], devices: Sequence, num_slices: int
+):
+    """Assemble the device array so that along every axis the DCN
+    factor varies SLOWEST: within one slice the ICI block is
+    topology-ordered by ``create_device_mesh``, and slices tile the
+    DCN extents (same layout contract as
+    ``mesh_utils.create_hybrid_device_mesh``, built explicitly so a
+    fabricated CPU device list exercises the identical code path)."""
+    groups = group_devices_by_slice(devices, num_slices)
+    dcn, ici = split_axes_dcn_ici(sizes, num_slices)
+    ici_shape = tuple(ici[a] for a in AXES)
+    dcn_shape = tuple(dcn[a] for a in AXES)
+    slice_blocks = [
+        _ici_device_array(ici_shape, g) for g in groups
+    ]
+    # [S, *ici] -> [*dcn, *ici] -> interleave (dcn_i, ici_i) pairs ->
+    # reshape to elementwise dcn*ici: DCN factor ends up as the outer
+    # (slowest) component of each mesh axis
+    stacked = np.stack(slice_blocks).reshape(dcn_shape + ici_shape)
+    n = len(AXES)
+    perm = []
+    for i in range(n):
+        perm.extend([i, n + i])
+    final_shape = tuple(dcn_shape[i] * ici_shape[i] for i in range(n))
+    return stacked.transpose(perm).reshape(final_shape)
 
 
 _GLOBAL_MESH = None

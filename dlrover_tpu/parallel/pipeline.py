@@ -268,14 +268,31 @@ def pipeline_train_step_1f1b(
             out = stage_fn(params, fwd_in)
             # last stage turns the microbatch around immediately;
             # the total loss is the MEAN over microbatches, so each
-            # microbatch's seed carries the 1/M
+            # microbatch's seed carries the 1/M.  The head forward +
+            # backward (an lm-head matmul can rival a whole stage at
+            # large vocab) runs under lax.cond so non-last stages
+            # skip it at runtime instead of computing it S-1 times
+            # and masking (ADVICE r2)
             y_mb = micro_y[fwd_idx]
-            loss_t, (dhead, seed) = jax.value_and_grad(
-                lambda h, o: apply_loss(h, o, y_mb) / M,
-                argnums=(0, 1),
-            )(hp, out)
-            loss_t = loss_t * M
             is_last = stage == S - 1
+
+            def turn_fn(operand):
+                hp_, out_, y_ = operand
+                loss_t, (dhead, seed) = jax.value_and_grad(
+                    lambda h, o: apply_loss(h, o, y_) / M,
+                    argnums=(0, 1),
+                )(hp_, out_)
+                return loss_t * M, dhead, seed
+
+            def skip_fn(operand):
+                shapes = jax.eval_shape(turn_fn, operand)
+                return jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes
+                )
+
+            loss_t, dhead, seed = jax.lax.cond(
+                is_last, turn_fn, skip_fn, (hp, out, y_mb)
+            )
             turn = jnp.logical_and(is_last, fwd_valid)
             loss_sum = loss_sum + jnp.where(turn, loss_t, 0.0)
             head_accum = jax.tree.map(

@@ -129,41 +129,72 @@ class MockK8sApi(K8sApi):
     def __init__(self):
         self.pods: Dict[str, Dict] = {}
         self.custom_resources: Dict[str, Dict] = {}
-        # one persistent queue per label selector — real Kubernetes
-        # delivers each event to EVERY watch stream, so distinct
-        # consumers (the master's PodWatcher, the operator's
-        # run_watch) must not steal events from each other; keying by
-        # selector (rather than per-stream) also buffers events
-        # across a consumer's re-subscribe gap, like list+watch with
-        # a resourceVersion does
-        self._watchers: Dict[str, "Queue[tuple]"] = {}
+        # one queue PER WATCH STREAM — real Kubernetes delivers each
+        # event to every open watch, so two streams (even on the same
+        # label selector) must both see every event (a shared
+        # per-selector queue would split events between them
+        # nondeterministically, ADVICE r2); a stream's queue is
+        # discarded when its generator exits, so departed consumers
+        # never accumulate events.  Replay follows resourceVersion
+        # semantics keyed by consumer thread (every real watch
+        # consumer — PodWatcher, the reconciler pump — owns a
+        # dedicated thread): a thread's FIRST subscribe replays
+        # buffered history (list+watch from rv 0), its re-subscribes
+        # resume after the last event it was delivered, so the 1s
+        # idle-return/re-subscribe cycle never re-delivers the whole
+        # history forever.
+        self._streams: List["Queue[tuple]"] = []
         self._watch_lock = threading.Lock()
-        # events that fired before a selector's first subscription
-        # are replayed to it (the mock's analog of list+watch from
-        # resourceVersion 0) — consumers must not lose the create/
-        # fail events that race their watch startup
-        self._history: List[tuple] = []
+        self._history: List[tuple] = []  # (seq, event)
+        self._seq = 0
+        # consumer identity is a THREAD-LOCAL token, not
+        # threading.get_ident(): CPython recycles idents, so a new
+        # watcher thread could inherit a dead thread's cursor and
+        # silently skip its first history replay; thread-local data
+        # dies with its thread, so a fresh thread always gets a fresh
+        # token (and replays history, like list+watch from rv 0)
+        self._tls = threading.local()
+        self._next_token = 0
+        self._cursors: Dict[int, int] = {}  # consumer token -> next seq
         self.create_calls = 0
         self.delete_calls = 0
 
+    def _consumer_token(self) -> int:
+        tok = getattr(self._tls, "token", None)
+        if tok is None:
+            with self._watch_lock:
+                tok = self._next_token
+                self._next_token += 1
+            self._tls.token = tok
+        return tok
+
     def _emit(self, event: tuple):
         with self._watch_lock:
-            self._history.append(event)
+            item = (self._seq, event)
+            self._seq += 1
+            self._history.append(item)
             del self._history[:-1000]
-            watchers = list(self._watchers.values())
-        for q in watchers:
-            q.put(event)
+            streams = list(self._streams)
+        for q in streams:
+            q.put(item)
 
-    def _watch_queue(self, label_selector: str) -> "Queue[tuple]":
+    def _register_stream(self) -> "Queue[tuple]":
+        tok = self._consumer_token()
         with self._watch_lock:
-            key = label_selector or ""
-            q = self._watchers.get(key)
-            if q is None:
-                q = Queue()
-                for event in self._history:
-                    q.put(event)
-                self._watchers[key] = q
+            q = Queue()
+            start = self._cursors.get(tok, 0)
+            for seq, event in self._history:
+                if seq >= start:
+                    q.put((seq, event))
+            self._streams.append(q)
             return q
+
+    def _unregister_stream(self, q):
+        with self._watch_lock:
+            try:
+                self._streams.remove(q)
+            except ValueError:
+                pass
 
     def create_pod(self, namespace, body):
         name = body["metadata"]["name"]
@@ -216,12 +247,21 @@ class MockK8sApi(K8sApi):
         ]
 
     def watch_pods(self, namespace, label_selector):
-        q = self._watch_queue(label_selector)
-        while True:
-            try:
-                yield q.get(timeout=1.0)
-            except Empty:
-                return
+        q = self._register_stream()
+        tok = self._consumer_token()
+        try:
+            while True:
+                try:
+                    seq, event = q.get(timeout=1.0)
+                except Empty:
+                    return
+                with self._watch_lock:
+                    self._cursors[tok] = max(
+                        self._cursors.get(tok, 0), seq + 1
+                    )
+                yield event
+        finally:
+            self._unregister_stream(q)
 
 
 class K8sClient:

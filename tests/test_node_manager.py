@@ -437,3 +437,84 @@ def test_heartbeat_timeout_relaunches(k8s):
         assert _wait_until(lambda: "tj-worker-2" in api.pods)
     finally:
         mgr.stop()
+
+
+def test_duplicate_death_report_never_aborts_job(k8s):
+    """A retried agent report (same terminal status delivered twice)
+    must not abort a job whose replacement already launched: with the
+    relaunch budget exactly consumed, the duplicate used to fall into
+    the job-exit branch (ADVICE r2 medium)."""
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-0", "Running")
+        assert _wait_until(
+            lambda: mgr.get_node(0) is not None
+            and mgr.get_node(0).status == NodeStatus.RUNNING
+        )
+        node = mgr.get_node(0)
+        # budget of 1: the first death consumes it exactly
+        node.max_relaunch_count = 1
+        mgr.update_node_status(
+            0, NodeType.WORKER, NodeStatus.FAILED,
+            exit_reason=NodeExitReason.PREEMPTED,
+        )
+        assert _wait_until(lambda: "tj-worker-2" in api.pods)
+        assert not mgr.job_exit_reason
+        # the @retry_request'd report delivers the same death again:
+        # no transition -> no re-handling -> no job abort
+        mgr.update_node_status(
+            0, NodeType.WORKER, NodeStatus.FAILED,
+            exit_reason=NodeExitReason.PREEMPTED,
+        )
+        assert not mgr.job_exit_reason
+        # the watcher's later FAILED->DELETED transition is also benign
+        mgr.update_node_status(
+            0, NodeType.WORKER, NodeStatus.DELETED,
+            exit_reason=NodeExitReason.PREEMPTED,
+        )
+        assert not mgr.job_exit_reason
+    finally:
+        mgr.stop()
+
+
+def test_two_watch_streams_same_selector_both_see_events(k8s):
+    """Real Kubernetes delivers each event to EVERY open watch; two
+    mock consumers on the SAME selector (each on its own thread, like
+    PodWatcher / the reconciler pump) must both see every event
+    instead of splitting one shared queue (ADVICE r2) — and a
+    consumer's RE-subscribe must resume after its last-seen event, not
+    replay the whole history every idle cycle."""
+    import threading
+
+    _, api = k8s
+    api.create_pod("test", {"metadata": {"name": "p1", "labels": {}}})
+
+    def consume(out):
+        # first subscribe: history replay + live events until idle
+        for event in api.watch_pods("test", "app=x"):
+            out.append(event)
+        # re-subscribe on the same thread (the consumers' retry loop)
+        for event in api.watch_pods("test", "app=x"):
+            out.append(("replayed", event))
+
+    seen1, seen2 = [], []
+    t1 = threading.Thread(target=consume, args=(seen1,))
+    t2 = threading.Thread(target=consume, args=(seen2,))
+    t1.start()
+    t2.start()
+    time.sleep(0.3)
+    api.set_pod_phase("p1", "Running")
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    for seen in (seen1, seen2):
+        kinds = [e[0] for e in seen]
+        assert kinds.count("added") == 1, kinds      # history replay
+        assert kinds.count("modified") == 1, kinds   # live fan-out
+        # the re-subscribe delivered NOTHING: cursor resumed past
+        # the already-seen history
+        assert "replayed" not in kinds, kinds
+    # departed streams are unregistered: no unbounded accumulation
+    assert api._streams == []

@@ -513,3 +513,49 @@ def test_q_adamw_8bit_tracks_adamw_on_transformer():
     # both trajectories decrease and stay close
     assert ql[-1] < ql[0] - 0.8, ql
     assert abs(ql[-1] - rl[-1]) < 0.15, (ql, rl)
+
+
+def test_q_adamw_state_carries_nu_domain_tag():
+    """The sqrt-domain nu storage is version-tagged inside the state
+    (and hence inside every checkpoint of it): a pre-tag checkpoint
+    misses the leaf and a generic pytree restore rejects it instead of
+    silently reinterpreting linear q*scale as sqrt(nu) (ADVICE r2)."""
+    import jax.numpy as jnp
+
+    from dlrover_tpu.optim.low_bit import (
+        NU_DOMAIN_SQRT_V1,
+        migrate_qadamw_state_v0,
+        q_adamw,
+    )
+
+    params = {"w": jnp.ones((64, 64))}
+    for bits in (8, 4):
+        opt = q_adamw(learning_rate=1e-2, bits=bits, block_size=64)
+        state = opt.init(params)
+        assert int(state.nu_domain) == NU_DOMAIN_SQRT_V1
+        g = {"w": jnp.full((64, 64), 0.1)}
+        _, state2 = opt.update(g, state, params)
+        assert int(state2.nu_domain) == NU_DOMAIN_SQRT_V1
+
+    # migration: an old linear-domain nu requantizes to sqrt domain
+    # with the same decoded values (within int8 precision)
+    from dlrover_tpu.ops.quantization import (
+        dequantize_blockwise,
+        quantize_blockwise,
+    )
+    from dlrover_tpu.optim.low_bit import QMoment
+
+    rows = 8
+    nu_true = jnp.abs(
+        jax.random.normal(jax.random.PRNGKey(0), (rows, 64))
+    ) * 1e-3
+    q, s, _ = quantize_blockwise(nu_true, 64)  # old LINEAR layout
+    old = (jnp.zeros((), jnp.int32), {"w": QMoment(q, s)},
+           {"w": QMoment(q, s)})
+    new = migrate_qadamw_state_v0(old, block_size=64)
+    assert int(new.nu_domain) == NU_DOMAIN_SQRT_V1
+    # decode new nu with the fused kernel's convention: (q*scale)^2
+    dec_sqrt = new.nu["w"].values.astype(jnp.float32) * new.nu["w"].scales
+    dec = dec_sqrt * dec_sqrt
+    ref = dequantize_blockwise(q, s, (rows, 64))
+    assert float(jnp.max(jnp.abs(dec - ref))) < 5e-5
