@@ -86,6 +86,13 @@ def _apply_plan_to_model(plan: AccelPlan, context: ModelContext):
     if hasattr(cfg, "dtype") and plan.compute_dtype in dtype_map:
         if cfg.dtype != dtype_map[plan.compute_dtype]:
             updates["dtype"] = dtype_map[plan.compute_dtype]
+    if (
+        plan.param_dtype
+        and hasattr(cfg, "param_dtype")
+        and plan.param_dtype in dtype_map
+        and cfg.param_dtype != dtype_map[plan.param_dtype]
+    ):
+        updates["param_dtype"] = dtype_map[plan.param_dtype]
     if plan.fp8 and hasattr(cfg, "fp8") and not cfg.fp8:
         updates["fp8"] = True
     if not updates:
@@ -140,7 +147,26 @@ def build_from_plan(
         plan.opt_state_rules = None
     rebuilt_ctx = dataclasses.replace(context, model=model)
     params = rebuilt_ctx.init_params()
-    optimizer = context.optimizer()
+    if plan.low_bit_opt:
+        from dlrover_tpu.optim import q_adamw
+
+        # NOTE: this REPLACES the user's optimizer (and its lr
+        # schedule) with blockwise low-bit AdamW — the optimizer
+        # family is a searchable dimension like the reference's
+        # q_adamw swap, but hyperparameters come from the strategy
+        # config, not the user's optax chain.  Pin your optimizer by
+        # setting context.extra["search_optimizer"] = False (the
+        # search then never emits low_bit_opt).
+        logger.warning(
+            "low_bit_opt: replacing the user optimizer with "
+            "q_adamw(bits=%d, %s)",
+            plan.low_bit_opt, plan.low_bit_opt_config,
+        )
+        optimizer = q_adamw(
+            bits=plan.low_bit_opt, **plan.low_bit_opt_config
+        )
+    else:
+        optimizer = context.optimizer()
     # shardings are derived from the abstract state so the offload
     # path can materialize moments straight into host DRAM below
     abstract_state = jax.eval_shape(

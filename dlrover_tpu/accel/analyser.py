@@ -146,6 +146,7 @@ def fits_in_hbm(
     remat: bool, activation_factor: float = 4.0,
     seq_shards: int = 1, expert_shards: int = 1,
     expert_param_fraction: float = 0.5,
+    half: bool = False, low_bit_opt: bool = False,
 ) -> bool:
     """Rough memory feasibility check for a candidate plan (the role
     of the reference's dryrun memory profiling, cheaper).
@@ -155,9 +156,18 @@ def fits_in_hbm(
     for: ``seq_shards`` (ring/Ulysses) divides activations;
     ``expert_shards`` divides the expert slice of the state
     (``expert_param_fraction``, conservatively half for a standard
-    MoE transformer where expert MLPs dominate)."""
+    MoE transformer where expert MLPs dominate).  Precision credits
+    (the single-chip levers): ``half`` stores params + grads in bf16
+    (2B each); ``low_bit_opt`` stores Adam moments blockwise-int8
+    (~2.3B/param incl. scales vs 8B fp32)."""
+    n = analysis.num_params
+    param_b = 2 * n if half else analysis.param_bytes
+    opt_b = (
+        int(2.3 * n) if low_bit_opt else analysis.opt_state_bytes
+    )
+    grad_b = 2 * n if half else 4 * n
     shard = max(1, fsdp_size * tensor_size)
-    state = analysis.model_state_bytes() / shard
+    state = (param_b + opt_b + grad_b) / shard
     if expert_shards > 1:
         f = expert_param_fraction
         state = state * (1.0 - f + f / expert_shards)

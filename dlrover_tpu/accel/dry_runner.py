@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 
 from dlrover_tpu.common.log import default_logger as logger
 
@@ -57,14 +58,25 @@ def profile_plan(
         context.sample_batch
     ), built.train_step
     try:
+        def sync(m):
+            # a scalar HOST FETCH is the only honest sync on every
+            # backend (block_until_ready does not wait through a
+            # remote device tunnel — it timed an XL step at 0.02s)
+            leaves = [
+                x for x in jax.tree_util.tree_leaves(m)
+                if hasattr(x, "ravel")
+            ]
+            if leaves:
+                float(jnp.asarray(leaves[0]).ravel()[0])
+
         t0 = time.perf_counter()
         state, metrics = step(state, batch)
-        jax.block_until_ready(metrics)
+        sync(metrics)
         compile_time = time.perf_counter() - t0
         t0 = time.perf_counter()
         for _ in range(profile_steps):
             state, metrics = step(state, batch)
-        jax.block_until_ready(metrics)
+        sync(metrics)
         step_time = (time.perf_counter() - t0) / profile_steps
     except Exception as e:  # noqa: BLE001
         logger.info("plan execution failed: %s", e)

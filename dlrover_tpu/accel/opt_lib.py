@@ -159,6 +159,9 @@ class MixedParallelOpt(Optimization):
         mc.sequence = int(config.get("sequence", 1))
         mc.expert = int(config.get("expert", 1))
         mc.data = int(config.get("data", -1))
+        # multi-slice topologies: force a hybrid ICI/DCN mesh
+        # (data/pipeline tile the slices; see parallel.mesh.DCN_AXES)
+        mc.num_slices = int(config.get("num_slices", 0))
         plan.param_rules = rules_for_model(
             getattr(context, "model", None),
             use_moe=True if mc.expert > 1 else None,
@@ -180,7 +183,39 @@ class AmpNativeOpt(Optimization):
 
 
 class HalfOpt(AmpNativeOpt):
+    """Half STORAGE: params kept in bf16 as well as compute
+    (reference half_optimization converts module weights; amp_native
+    is compute-only).  Halves parameter HBM — with low-bit moments
+    this is what fits a 1.5B model on one 16 GB chip."""
+
     name = "half"
+
+    def apply(self, plan, config, context=None):
+        plan = super().apply(plan, config, context)
+        plan.param_dtype = config.get("param_dtype", "bfloat16")
+        plan.notes.append(f"param dtype {plan.param_dtype}")
+        return plan
+
+
+class LowBitOptimizerOpt(Optimization):
+    """Blockwise low-bit AdamW moments (int8 fused Pallas step or
+    int4 packed) replacing the user optimizer — the optimizer family
+    as a searchable dimension, like the reference's
+    ``q_adamw/q_adafactor`` (atorch/optimizers/low_bit/).  4x (8x)
+    less optimizer HBM than fp32 Adam."""
+
+    name = "low_bit_opt"
+
+    def apply(self, plan, config, context=None):
+        plan.low_bit_opt = int(config.get("bits", 8))
+        plan.low_bit_opt_config = {
+            "learning_rate": float(config.get("learning_rate", 3e-4)),
+            "weight_decay": float(config.get("weight_decay", 0.1)),
+        }
+        plan.notes.append(
+            f"int{plan.low_bit_opt} optimizer moments (q_adamw)"
+        )
+        return plan
 
 
 class Fp8Opt(Optimization):
@@ -286,7 +321,7 @@ class OptimizationLibrary:
             TensorParallelOpt, SequenceParallelOpt, ExpertParallelOpt,
             MixedParallelOpt, AmpNativeOpt, HalfOpt, Fp8Opt,
             CheckpointOpt, ModuleReplaceOpt, PipelineParallelOpt,
-            OffloadOptStateOpt,
+            OffloadOptStateOpt, LowBitOptimizerOpt,
         ):
             self.register(cls())
 

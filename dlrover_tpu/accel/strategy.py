@@ -38,6 +38,15 @@ class AccelPlan:
     # optimizer states live in host DRAM between steps
     # (reference: adam_offload.py; here via jax memory kinds)
     offload_opt_state: bool = False
+    # parameter STORAGE dtype ("" = leave the model's default); the
+    # "half" optimization sets bfloat16 — halves param HBM, the
+    # single-chip lever the reference's half_optimization pulls
+    param_dtype: str = ""
+    # replace the user optimizer with blockwise low-bit AdamW
+    # (0 = off; 8/4 = moment bits) — reference: the low-bit optimizer
+    # family as a searchable dimension (atorch/optimizers/low_bit)
+    low_bit_opt: int = 0
+    low_bit_opt_config: Dict[str, float] = field(default_factory=dict)
     notes: List[str] = field(default_factory=list)
 
     def effective_opt_rules(self) -> PartitionRules:

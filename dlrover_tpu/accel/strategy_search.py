@@ -34,6 +34,8 @@ class Candidate:
     expert: int = 1
     remat: bool = False
     grad_accum: int = 1
+    half: bool = False          # bf16 param storage
+    low_bit_opt: bool = False   # int8 optimizer moments
     step_time_s: Optional[float] = None
 
     def features(self) -> Dict[str, float]:
@@ -44,6 +46,8 @@ class Candidate:
             "log_expert": math.log2(self.expert),
             "remat": float(self.remat),
             "log_accum": math.log2(self.grad_accum),
+            "half": float(self.half),
+            "low_bit": float(self.low_bit_opt),
         }
 
     def describe(self) -> str:
@@ -53,6 +57,8 @@ class Candidate:
             f"{f'xep{self.expert}' if self.expert > 1 else ''}"
             f"{'+remat' if self.remat else ''}"
             f"{f'+ga{self.grad_accum}' if self.grad_accum > 1 else ''}"
+            f"{'+half' if self.half else ''}"
+            f"{'+int8opt' if self.low_bit_opt else ''}"
         )
 
 
@@ -73,6 +79,7 @@ def _divisors(n: int) -> List[int]:
 def _build_strategy(
     data: int, fsdp: int, tensor: int, remat: bool, grad_accum: int,
     sequence: int = 1, expert: int = 1,
+    half: bool = False, low_bit_opt: bool = False,
 ) -> Strategy:
     opts: List[Tuple[str, Dict]] = []
     if tensor > 1 or expert > 1 or (fsdp > 1 and sequence > 1):
@@ -89,9 +96,19 @@ def _build_strategy(
         opts.append((
             "sequence_parallel", {"size": sequence, "mode": "ring"},
         ))
-    opts.append(("amp_native", {}))
+    opts.append(("half", {}) if half else ("amp_native", {}))
+    if low_bit_opt:
+        opts.append(("low_bit_opt", {"bits": 8}))
     if remat:
         opts.append(("checkpoint", {}))
+    import jax
+
+    if jax.default_backend() == "tpu":
+        # fixed module-replacement pass on real hardware (reference:
+        # module_replace_optimization always swaps FA in when legal);
+        # skipped on the CPU test mesh where the Pallas kernel runs
+        # in interpreter mode
+        opts.append(("module_replace", {"attention": "flash"}))
     return Strategy(opts=opts)
 
 
@@ -143,42 +160,62 @@ def generate_candidates(
             and analysis.seq_len % tensor == 0
         ):
             variants.append((1, tensor, 1))   # ring sp
+        # int8-moment variants swap the user optimizer for q_adamw
+        # (training-semantics change); opt out via
+        # context.extra["search_optimizer"] = False
+        search_opt = bool(
+            getattr(context, "extra", {}).get("search_optimizer", True)
+        )
         for tp, sp, ep in variants:
-            for remat in (False, True):
-                if not fits_in_hbm(
-                    analysis, fsdp, tp, remat,
-                    seq_shards=sp, expert_shards=ep,
-                ):
+            # precision levels, cheapest-HBM last (the single-chip
+            # levers: bf16 param storage, int8 optimizer moments)
+            for half, lowbit in (
+                (False, False), (True, False), (True, True),
+            ):
+                if lowbit and not search_opt:
                     continue
-                for ga in grad_accums:
-                    if batch % (ga * max(1, data * fsdp)):
+                for remat in (False, True):
+                    if not fits_in_hbm(
+                        analysis, fsdp, tp, remat,
+                        seq_shards=sp, expert_shards=ep,
+                        half=half, low_bit_opt=lowbit,
+                    ):
                         continue
-                    key = (data, fsdp, tp, sp, ep, remat, ga)
-                    if key in seen:
-                        continue
-                    seen.add(key)
-                    cands.append(Candidate(
-                        strategy=_build_strategy(
-                            data, fsdp, tp, remat, ga,
+                    for ga in grad_accums:
+                        if batch % (ga * max(1, data * fsdp)):
+                            continue
+                        key = (
+                            data, fsdp, tp, sp, ep, remat, ga,
+                            half, lowbit,
+                        )
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        cands.append(Candidate(
+                            strategy=_build_strategy(
+                                data, fsdp, tp, remat, ga,
+                                sequence=sp, expert=ep,
+                                half=half, low_bit_opt=lowbit,
+                            ),
+                            data=data, fsdp=fsdp, tensor=tp,
                             sequence=sp, expert=ep,
-                        ),
-                        data=data, fsdp=fsdp, tensor=tp,
-                        sequence=sp, expert=ep,
-                        remat=remat, grad_accum=ga,
-                    ))
+                            remat=remat, grad_accum=ga,
+                            half=half, low_bit_opt=lowbit,
+                        ))
     if not cands:
         # nothing fits the model: fall back to the most
         # memory-frugal plan and let the dry run surface the OOM
         logger.warning(
             "no candidate passed the HBM model; falling back to "
-            "fsdp x remat"
+            "fsdp x remat x half x int8-opt"
         )
         cands.append(Candidate(
             strategy=_build_strategy(
-                1, num_devices, 1, True, grad_accums[0]
+                1, num_devices, 1, True, grad_accums[0],
+                half=True, low_bit_opt=True,
             ),
             data=1, fsdp=num_devices, tensor=1, remat=True,
-            grad_accum=grad_accums[0],
+            grad_accum=grad_accums[0], half=True, low_bit_opt=True,
         ))
     return cands
 
@@ -271,6 +308,8 @@ def search_strategy(
             Parameter("log_expert", 0.0, math.log2(num_devices)),
             Parameter("remat", 0.0, 1.0),
             Parameter("log_accum", 0.0, math.log2(max(grad_accums))),
+            Parameter("half", 0.0, 1.0),
+            Parameter("low_bit", 0.0, 1.0),
         ]
         bo = BayesianOptimizer(params, seed=seed)
         rng = np.random.default_rng(seed)

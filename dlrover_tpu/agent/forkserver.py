@@ -1,0 +1,278 @@
+"""Warm-template worker spawner (fork server).
+
+Restart latency is the dominant term of goodput under churn: a cold
+``python script.py`` pays ~3-5 s of interpreter + jax/flax/optax
+imports before the first restored step.  The fork server keeps a
+TEMPLATE process parked after pre-importing the heavy module set —
+crucially WITHOUT initializing the jax backend (imports only; no op
+runs in the template, so the fork inherits no XLA client and each
+child initializes its own) — and every (re)start forks the template
+and runs the entrypoint in the child via ``runpy``.
+
+Reference analog: the elastic agent's worker respawn path
+(``dlrover/python/elastic_agent/torch/training.py``) — torch keeps
+respawn cheap with persistent workers; on TPU the equivalent lever is
+amortizing import cost across incarnations.
+
+Protocol (dedicated pipe fds, so worker stdout stays untouched):
+agent -> template: one JSON line per spawn {"env": {...}, "argv": [...]};
+template -> agent: {"event": "spawned", "pid": N} and, from the reap
+loop, {"event": "exit", "pid": N, "code": C}.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+DEFAULT_PRELOAD = "jax,jax.numpy,flax,optax,numpy"
+
+# jax freezes env-derived config at import, which happens in the
+# TEMPLATE; a forked worker whose env differs must push these through
+# the config API or e.g. the persistent compilation cache silently
+# stays off and every restart recompiles (the dominant goodput loss)
+_JAX_ENV_CONFIG = {
+    "JAX_COMPILATION_CACHE_DIR": (
+        "jax_compilation_cache_dir", str),
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": (
+        "jax_persistent_cache_min_entry_size_bytes", int),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": (
+        "jax_persistent_cache_min_compile_time_secs", float),
+}
+
+
+def _sync_jax_config_from_env():
+    if "jax" not in sys.modules:
+        return
+    import jax
+
+    for env_key, (cfg_key, cast) in _JAX_ENV_CONFIG.items():
+        val = os.environ.get(env_key)
+        if val is None:
+            continue
+        try:
+            jax.config.update(cfg_key, cast(val))
+        except Exception:  # noqa: BLE001 - unknown option on old jax
+            pass
+
+
+def _template_main(req_fd: int, ev_fd: int):
+    """Runs inside the template process (see __main__ below)."""
+    for mod in os.environ.get(
+        "DLROVER_PRELOAD", DEFAULT_PRELOAD
+    ).split(","):
+        mod = mod.strip()
+        if not mod:
+            continue
+        try:
+            __import__(mod)
+        except Exception:  # noqa: BLE001 - preload is best-effort
+            pass
+    req = os.fdopen(req_fd, "r")
+    ev = os.fdopen(ev_fd, "w")
+    children: Dict[int, bool] = {}
+    lock = threading.Lock()
+
+    def reap_loop():
+        while True:
+            with lock:
+                live = list(children)
+            for pid in live:
+                try:
+                    done, status = os.waitpid(pid, os.WNOHANG)
+                except ChildProcessError:
+                    done, status = pid, 0
+                if done:
+                    code = (
+                        os.waitstatus_to_exitcode(status)
+                        if done == pid else 0
+                    )
+                    with lock:
+                        children.pop(pid, None)
+                    ev.write(json.dumps(
+                        {"event": "exit", "pid": pid, "code": code}
+                    ) + "\n")
+                    ev.flush()
+            time.sleep(0.05)
+
+    threading.Thread(target=reap_loop, daemon=True).start()
+    for line in req:
+        try:
+            spec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if spec.get("event") == "shutdown":
+            break
+        pid = os.fork()
+        if pid == 0:
+            # ---- child: become the worker
+            try:
+                os.environ.clear()
+                os.environ.update(spec["env"])
+                _sync_jax_config_from_env()
+                argv = spec["argv"]
+                sys.argv = list(argv)
+                import runpy
+
+                runpy.run_path(argv[0], run_name="__main__")
+                os._exit(0)
+            except SystemExit as e:
+                os._exit(int(e.code or 0))
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                os._exit(1)
+        with lock:
+            children[pid] = True
+        ev.write(json.dumps({"event": "spawned", "pid": pid}) + "\n")
+        ev.flush()
+    # agent went away: leave children to the reaper of last resort
+    os._exit(0)
+
+
+class ForkedWorkerHandle:
+    """Popen-compatible surface over a template-forked worker."""
+
+    def __init__(self, pid: int, server: "WorkerForkServer"):
+        self.pid = pid
+        self._server = server
+
+    def poll(self) -> Optional[int]:
+        return self._server.exit_code(self.pid)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            code = self.poll()
+            if code is not None:
+                return code
+            if deadline is not None and time.time() > deadline:
+                raise subprocess.TimeoutExpired(
+                    cmd=f"forked-{self.pid}", timeout=timeout or 0
+                )
+            time.sleep(0.05)
+
+    def send_signal(self, sig: int):
+        if self.poll() is None:
+            try:
+                os.kill(self.pid, sig)
+            except ProcessLookupError:
+                pass
+
+    def terminate(self):
+        self.send_signal(signal.SIGTERM)
+
+    def kill(self):
+        self.send_signal(signal.SIGKILL)
+
+
+class WorkerForkServer:
+    """Agent-side handle: owns the template process and the protocol."""
+
+    def __init__(self, preload: str = ""):
+        self._preload = preload or os.environ.get(
+            "DLROVER_PRELOAD", DEFAULT_PRELOAD
+        )
+        self._proc: Optional[subprocess.Popen] = None
+        self._req = None
+        self._exits: Dict[int, int] = {}
+        self._spawned: List[int] = []
+        self._lock = threading.Lock()
+        self._reader: Optional[threading.Thread] = None
+
+    def _ensure_template(self):
+        if self._proc is not None and self._proc.poll() is None:
+            return
+        req_r, req_w = os.pipe()
+        ev_r, ev_w = os.pipe()
+        env = dict(os.environ, DLROVER_PRELOAD=self._preload)
+        self._proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.agent.forkserver",
+                str(req_r), str(ev_w),
+            ],
+            env=env, pass_fds=(req_r, ev_w), close_fds=True,
+        )
+        os.close(req_r)
+        os.close(ev_w)
+        self._req = os.fdopen(req_w, "w")
+        ev = os.fdopen(ev_r, "r")
+
+        def read_events(ev=ev):
+            for line in ev:
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                with self._lock:
+                    if msg["event"] == "spawned":
+                        self._spawned.append(msg["pid"])
+                    elif msg["event"] == "exit":
+                        self._exits[msg["pid"]] = msg["code"]
+
+        self._reader = threading.Thread(target=read_events, daemon=True)
+        self._reader.start()
+
+    def spawn(
+        self, argv: List[str], env: Dict[str, str],
+        timeout: float = 30.0,
+    ) -> ForkedWorkerHandle:
+        """Fork the template into a worker running ``argv`` (argv[0]
+        is the script path — the interpreter is already running)."""
+        self._ensure_template()
+        before = len(self._spawned)
+        self._req.write(json.dumps({"env": env, "argv": argv}) + "\n")
+        self._req.flush()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if len(self._spawned) > before:
+                    return ForkedWorkerHandle(self._spawned[-1], self)
+            time.sleep(0.01)
+        raise RuntimeError("fork server did not spawn a worker in time")
+
+    def exit_code(self, pid: int) -> Optional[int]:
+        with self._lock:
+            code = self._exits.get(pid)
+        if code is not None:
+            return code
+        # exit events come FROM the template; if it died (OOM, crash)
+        # they never arrive — fall back to direct liveness so the
+        # agent's monitor/stop paths cannot wait forever on a pid
+        # that is already gone
+        if self._proc is None or self._proc.poll() is not None:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                with self._lock:
+                    self._exits[pid] = -1
+                return -1
+            except PermissionError:
+                pass
+        return None
+
+    def close(self):
+        if self._proc is None:
+            return
+        try:
+            self._req.write(json.dumps({"event": "shutdown"}) + "\n")
+            self._req.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait()
+        self._proc = None
+
+
+if __name__ == "__main__":
+    _template_main(int(sys.argv[1]), int(sys.argv[2]))

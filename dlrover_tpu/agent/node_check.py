@@ -227,22 +227,31 @@ def run_node_check(
     """
     client = client or MasterClient.singleton()
     mock_error()
-    # one timer over the whole work phase so injected or real chip
-    # slowness lands in THIS node's number (the reference reports
-    # per-node work time, node_check/utils.py:25-46)
+    if world_size > 1:
+        # ENTRY barrier: align the start of the timed work phase so a
+        # peer that arrives late (slow boot, slow previous round)
+        # cannot leak into other nodes' work numbers
+        wait = bm_sync_barrier(
+            client, f"{round_id}_entry", world_size
+        )
+        logger.info("entry barrier wait %.3fs (not counted)", wait)
+    # per-node WORK timer (the reference reports per-node work time,
+    # node_check/utils.py:25-46): injected or real chip slowness lands
+    # in THIS node's number only
     work_start = time.perf_counter()
     mock_straggle()
     bm_chip_matmul(size=matmul_size)
-    bm_collective_probe()
     elapsed = time.perf_counter() - work_start
+    # fabric probe over every visible device — with a live
+    # jax.distributed runtime this crosses hosts (ICI/DCN).  Timed
+    # SEPARATELY from the work phase: a global collective completes at
+    # the pace of its slowest participant, so folding it into elapsed
+    # would inflate every healthy node's number and mask attribution.
+    bm_collective_probe()
     if world_size > 1:
-        # master-mediated barrier: synchronizes the round across nodes
-        # (and fails when a peer is dead), but its wait time is NOT
-        # part of this node's elapsed — a slow peer would otherwise
-        # inflate every healthy node's number and mask the straggler
-        # (the reference reports per-node work time too,
-        # node_check/utils.py:25-46)
+        # EXIT barrier: synchronizes the round across nodes and fails
+        # when a peer is dead
         wait = bm_sync_barrier(client, round_id, world_size)
-        logger.info("barrier wait %.3fs (not counted)", wait)
+        logger.info("exit barrier wait %.3fs (not counted)", wait)
     logger.info("node check elapsed %.3fs", elapsed)
     return elapsed

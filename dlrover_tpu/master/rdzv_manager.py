@@ -340,13 +340,22 @@ class NetworkCheckRendezvousManager(RendezvousManager):
 
     def detect_stragglers(self) -> Tuple[List[int], float]:
         """Nodes slower than ``straggler_factor ×`` median elapsed
-        (reference ``_detect_stragglers:550``)."""
+        (reference ``_detect_stragglers:550``).  At exactly 2 nodes
+        the baseline is the FASTER node (``median_low``): the
+        interpolated median would average the straggler's own time
+        into the baseline, so a straggler could never exceed 2x the
+        "median" of itself and the healthy node and the rule would be
+        a no-op.  With >=3 nodes the reference's interpolated median
+        applies unchanged."""
         with self._lock:
             rnd = max(self._check_round - 1, 0)
             times = self._node_times.get(rnd, {})
             if len(times) < 2:
                 return [], 0.0
-            med = statistics.median(times.values())
+            if len(times) == 2:
+                med = statistics.median_low(times.values())
+            else:
+                med = statistics.median(times.values())
             if med <= 0:
                 return [], med
             factor = NetworkCheckConstant.STRAGGLER_FACTOR

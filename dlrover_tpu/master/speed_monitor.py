@@ -7,6 +7,7 @@ samples/sec over a sliding window, tracks the globally completed step
 which worker membership changed so throughput comparisons skip them.
 """
 
+import statistics
 import threading
 import time
 from collections import deque
@@ -30,6 +31,18 @@ class SpeedMonitor:
         self._peak_flops = 0.0
         self._productive_seconds = 0.0
         self._last_productive_mark = 0.0
+        # rolling window of RAW step gaps: a restart/rendezvous
+        # silence is detected as a gap far above the typical step
+        # time (3x the window median) and only a step's worth of it
+        # counts as productive — without this, a 20 s recovery gap
+        # under churn would be booked as productive (only >300 s
+        # silences were excluded) and goodput would read ~100% no
+        # matter how often the job dies.  The window holds raw gaps
+        # (outliers included): a lone restart barely moves the
+        # median, while a legitimate regime change (scale-down makes
+        # steps 4x slower) shifts it within a window's worth of steps
+        # — an EMA that skips outliers would freeze instead
+        self._gap_window: Deque[float] = deque(maxlen=64)
 
     def set_batch_size(self, batch_size: int):
         self._batch_size = batch_size
@@ -47,13 +60,24 @@ class SpeedMonitor:
         ts = timestamp or time.time()
         with self._lock:
             if step > self._global_step:
-                # productive time: gaps between consecutive step
-                # reports; long silences (restarts, rendezvous) are
-                # capped so they count as lost time in goodput
+                # productive time: gaps between consecutive NEW-step
+                # reports.  A gap well above the typical step time
+                # (restart, rendezvous, recompute of lost steps) is
+                # capped at ~one step's worth; the rest is lost time.
                 if self._last_productive_mark:
                     gap = ts - self._last_productive_mark
                     if 0 < gap < 300.0:
-                        self._productive_seconds += gap
+                        if self._gap_window:
+                            med = statistics.median(self._gap_window)
+                            self._productive_seconds += min(
+                                gap, 3.0 * med
+                            )
+                        else:
+                            # no baseline yet: allow a generous
+                            # first-step/compile gap but never book a
+                            # whole restart silence as productive
+                            self._productive_seconds += min(gap, 60.0)
+                        self._gap_window.append(gap)
                 self._last_productive_mark = ts
                 self._global_step = step
                 self._last_step_time = ts

@@ -170,6 +170,22 @@ class KvVariable:
             self._lib.kv_evict_below(self._handle, min_freq)
         )
 
+    def evict_to_capacity(self, max_rows: int) -> int:
+        """Frequency-ordered overflow policy: evict coldest rows until
+        at most ``max_rows`` remain (reference: the kv-variable
+        frequency/overflow policies, tfplus
+        kv_variable_ops.cc:37 / kernels/kv_variable.h:89).  The
+        threshold is the (n - max_rows)-th smallest frequency; ties at
+        the threshold may keep the table slightly under budget (every
+        row at the cutoff is evicted) — never over."""
+        n = len(self)
+        if n <= max_rows:
+            return 0
+        _, _, freq = self.export()
+        order = np.sort(freq)
+        cutoff = int(order[n - max_rows - 1]) + 1
+        return self.evict_below(cutoff)
+
     def export(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         n = len(self)
         keys = np.empty(n, dtype=np.int64)

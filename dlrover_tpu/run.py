@@ -70,6 +70,12 @@ def parse_args(argv: Optional[List[str]] = None):
         help="job master host:port; spawned locally if empty on rank 0",
     )
     parser.add_argument("--monitor_interval", type=float, default=2.0)
+    parser.add_argument(
+        "--warm-restart", action="store_true", dest="warm_restart",
+        help="fork restarted workers from a pre-imported template "
+        "process (cuts restart latency by the interpreter+jax import "
+        "cost; see agent/forkserver.py)",
+    )
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -154,6 +160,7 @@ def run(args) -> int:
         max_nodes=max_nodes,
         node_unit=args.node_unit,
         network_check=args.network_check,
+        warm_restart=args.warm_restart,
     )
 
     # Breakpoint-checkpoint hook: persist any shm checkpoint before a

@@ -183,9 +183,11 @@ class ShmDataLoader:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def _probe_slot_bytes(self) -> Tuple[int, List[int]]:
+    def _probe_slot_bytes(self) -> Tuple[int, Optional[Any]]:
         """Size slots from one locally-built batch (+25% headroom for
-        ragged batches); returns (slot_bytes, consumed_indices)."""
+        ragged batches).  The probe batch is RETURNED for delivery —
+        re-reading its indices through a worker would run every
+        sample's (possibly expensive) read twice."""
         probe = []
         for _ in range(self.batch_size):
             try:
@@ -193,20 +195,24 @@ class ShmDataLoader:
             except StopIteration:
                 break
         if not probe:
-            return 0, []
+            return 0, None
         samples = [self._read_fn(i) for i in probe]
-        _, total, _ = _collate_to_layout(self._collate(samples))
-        return int(total * 1.25), probe
+        batch = self._collate(samples)
+        _, total, _ = _collate_to_layout(batch)
+        if len(probe) < self.batch_size:
+            # short final batch: size from per-sample bytes
+            total = int(total * self.batch_size / len(probe))
+        return int(total * 1.25), batch
 
     def _start(self):
         from dlrover_tpu.common.multi_process import get_or_create_shm
 
-        first_indices: List[int] = []
+        probe_batch = None
         if self._slot_bytes is None:
-            self._slot_bytes, first_indices = self._probe_slot_bytes()
+            self._slot_bytes, probe_batch = self._probe_slot_bytes()
             if not self._slot_bytes:
                 self._started = True
-                self._pending_first = []
+                self._probe_batch = None
                 return
         self._task_q = self._ctx.Queue()
         self._result_q = self._ctx.Queue()
@@ -233,7 +239,7 @@ class ShmDataLoader:
             )
             p.start()
             self._procs.append(p)
-        self._pending_first = first_indices
+        self._probe_batch = probe_batch
         self._started = True
 
     def shutdown(self):
@@ -265,11 +271,6 @@ class ShmDataLoader:
     # -- iteration ----------------------------------------------------------
 
     def _next_index_batch(self) -> Optional[List[int]]:
-        if self._pending_first:
-            out, self._pending_first = self._pending_first, []
-            if len(out) == self.batch_size:
-                return out
-            return out or None
         out = []
         for _ in range(self.batch_size):
             try:
@@ -316,6 +317,14 @@ class ShmDataLoader:
     def __iter__(self):
         if not self._started:
             self._start()
+        if self._probe_batch is not None:
+            # deliver the sizing-probe batch directly (already read
+            # and collated in-process)
+            batch, self._probe_batch = self._probe_batch, None
+            self._batches += 1
+            yield self._place(batch)
+            if self._on_batch_done is not None:
+                self._on_batch_done(self.batch_size)
         if not self._procs:
             return
         inflight = 0

@@ -172,13 +172,18 @@ def test_search_prefers_sharded_when_model_does_not_fit(monkeypatch):
         "dlrover_tpu.accel.strategy_search.analyse", tight_analyse
     )
     cands = generate_candidates(context, 8)
-    assert all(c.fsdp * c.tensor >= 4 for c in cands), [
-        c.describe() for c in cands
-    ]
+    # every surviving candidate pays the tight HBM some other way:
+    # >=4-way state sharding, or the precision levers (bf16 params +
+    # int8 moments shrink state ~3.4x)
+    assert all(
+        c.fsdp * c.tensor >= 4 or (c.half and c.low_bit_opt)
+        or (c.half and c.fsdp * c.tensor >= 2)
+        for c in cands
+    ), [c.describe() for c in cands]
+    assert any(c.fsdp * c.tensor >= 4 for c in cands)
     result = search_strategy(
         context, 8, dry_run_budget=3, grad_accums=(1,)
     )
-    assert result.best.fsdp > 1 or result.best.tensor > 1
     assert result.best.step_time_s is not None
 
 

@@ -160,3 +160,45 @@ def test_jax_bridge_gather_in_jit():
     np.testing.assert_allclose(
         np.asarray(out)[0], ref.sum(-1), rtol=1e-6
     )
+
+
+def test_eviction_past_capacity_end_to_end():
+    """Drive the table far past its initial capacity with a skewed
+    (training-like) access pattern, then apply the frequency-based
+    overflow policy: hot keys survive, cold ones are evicted, and the
+    freed keys re-insert cleanly on next touch (reference:
+    tfplus kv_variable_ops.cc:37 frequency/overflow policies)."""
+    rng = np.random.default_rng(0)
+    kv = KvVariable(dim=8, initial_capacity=256)
+    hot = np.arange(100, dtype=np.int64)
+    # hot keys touched every "step", cold keys once each
+    for step in range(10):
+        kv.gather(hot)
+        cold = np.arange(
+            1000 + step * 1000, 1000 + (step + 1) * 1000,
+            dtype=np.int64,
+        )
+        kv.gather(cold)
+    assert len(kv) == 100 + 10_000  # grew ~40x past initial capacity
+    evicted = kv.evict_to_capacity(500)
+    assert evicted >= 100 + 10_000 - 500
+    assert len(kv) <= 500
+    # every hot key survived with its frequency intact
+    assert (kv.frequency(hot) == 10).all()
+    hot_vals = kv.gather_or_zeros(hot)
+    assert not np.allclose(hot_vals, 0.0)
+    # evicted cold keys read as zeros now...
+    cold0 = np.arange(1000, 2000, dtype=np.int64)
+    assert np.allclose(kv.gather_or_zeros(cold0), 0.0)
+    # ...and re-insert fresh on the next training touch
+    re = kv.gather(cold0[:10])
+    assert re.shape == (10, 8)
+    assert len(kv) <= 510
+    assert (kv.frequency(cold0[:10]) == 1).all()
+
+
+def test_evict_to_capacity_noop_under_budget():
+    kv = KvVariable(dim=4)
+    kv.gather(np.arange(50, dtype=np.int64))
+    assert kv.evict_to_capacity(100) == 0
+    assert len(kv) == 50
