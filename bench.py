@@ -250,6 +250,96 @@ def bench_xl_train_step(jax, results: dict):
     }
 
 
+def bench_input_pipeline(jax, results: dict):
+    """Input-bound fraction of the train step: GPT-2-small batch 16
+    fed by the cross-process shm dataloader (2 workers, synthetic
+    token batches) — the loader's measured input_wait over the loop's
+    wall time must be a rounding error (reference capability:
+    shm_dataloader.py:284 wait-free input)."""
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models.gpt import (
+        GPT,
+        GPTConfig,
+        cross_entropy_loss,
+    )
+    from dlrover_tpu.trainer.elastic_trainer import TrainState
+    from dlrover_tpu.trainer.shm_loader import ShmDataLoader
+
+    if os.getenv("BENCH_SMOKE"):
+        return
+    batch, seq = 16, 1024
+    cfg = GPTConfig.gpt2_small(
+        max_seq_len=seq, attention_impl="flash"
+    )
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=seq)
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    state = TrainState.create(params, optimizer)
+
+    @jax.jit
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p, t: cross_entropy_loss(
+                model.apply({"params": p}, t[:, :-1]), t[:, 1:]
+            )
+        )(state.params, tokens)
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        import optax as _o
+
+        return (
+            TrainState(
+                params=_o.apply_updates(state.params, updates),
+                opt_state=new_opt, step=state.step + 1,
+            ),
+            loss,
+        )
+
+    steps = 16
+    loader = ShmDataLoader(
+        read_fn=_read_tokens,
+        batch_size=batch,
+        index_iter=range(batch * (steps + 1)),
+        num_workers=2,
+    )
+    try:
+        it = iter(loader)
+        first = next(it)  # covers compile + loader spin-up
+        state, loss = step(state, jnp.asarray(first))
+        float(loss)
+        t0 = time.perf_counter()
+        wait0 = loader.stats()["input_wait_s"]
+        n = 0
+        for host_batch in it:
+            state, loss = step(state, jnp.asarray(host_batch))
+            n += 1
+        float(loss)
+        wall = time.perf_counter() - t0
+        input_wait = loader.stats()["input_wait_s"] - wait0
+    finally:
+        loader.shutdown()
+    results["input_pipeline"] = {
+        "model": "gpt2_small",
+        "batch": batch,
+        "steps": n,
+        "loader": "shm 2-proc workers",
+        "step_wall_s": round(wall / max(1, n), 4),
+        "input_wait_s": round(input_wait, 4),
+        "input_bound_pct": round(100 * input_wait / wall, 2),
+    }
+
+
+def _read_tokens(i: int):
+    """Module-level (picklable) synthetic sample for the input bench."""
+    import numpy as np
+
+    rng = np.random.default_rng(i)
+    return rng.integers(0, 50257, 1025).astype(np.int32)
+
+
 def bench_sparse_kv(jax, results: dict):
     """Sparse path on the chip: KvVariable host-table gather under
     jit (io_callback round trip quantified) + GroupAdam sparse update
@@ -280,39 +370,71 @@ def bench_sparse_kv(jax, results: dict):
         table.gather(k)
     host_dt = (time.perf_counter() - t0) / len(key_sets)
 
-    # (b) the same gather inside a jitted device program
-    @jax.jit
-    def fwd(keys):
-        emb = table.jax_gather(keys)  # io_callback(ordered)
-        return (emb * emb).sum()
-
-    fwd(jnp.asarray(key_sets[0]))  # compile
-    float(fwd(jnp.asarray(key_sets[0])))
-    t0 = time.perf_counter()
-    for k in key_sets:
-        out = fwd(jnp.asarray(k))
-    float(out)
-    jit_dt = (time.perf_counter() - t0) / len(key_sets)
-
-    # (c) full sparse train step: jit forward + host GroupAdam update
+    # (b) host gather + host GroupAdam update (the sparse train step
+    # minus device compute) — the sparse tables live host-side by
+    # design, like the reference's CPU parameter servers
     grads = np.ones((B, dim), np.float32)
     t0 = time.perf_counter()
     for k in key_sets:
-        float(fwd(jnp.asarray(k)))
+        table.gather(k)
         opt.apply_gradients(k, grads)
     step_dt = (time.perf_counter() - t0) / len(key_sets)
+
+    # (c) the gather INSIDE a jitted device program (io_callback).
+    # Host callbacks HANG through a tunneled remote device (the
+    # callback would have to run on the far side), so this leg runs
+    # in a subprocess with a hard timeout and reports honestly when
+    # the platform cannot do it.
+    probe = (
+        "import time, numpy as np, jax, jax.numpy as jnp\n"
+        "from dlrover_tpu.ops.kv_variable import KvVariable\n"
+        f"dim, B = {dim}, {B}\n"
+        "t = KvVariable(dim=dim, initial_capacity=1 << 16)\n"
+        "ks = [np.random.default_rng(i).integers(0, 200000, B)"
+        ".astype(np.int64) for i in range(4)]\n"
+        "f = jax.jit(lambda k: (lambda e: (e * e).sum())"
+        "(t.jax_gather(k)))\n"
+        "float(f(jnp.asarray(ks[0])))\n"
+        "t0 = time.perf_counter()\n"
+        "for k in ks:\n"
+        "    out = f(jnp.asarray(k))\n"
+        "float(out)\n"
+        "print('JIT_DT', (time.perf_counter() - t0) / len(ks))\n"
+    )
+    jit_dt = None
+    jit_note = ""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe], cwd=os.getcwd(),
+            capture_output=True, text=True, timeout=120,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("JIT_DT"):
+                jit_dt = float(line.split()[1])
+        if jit_dt is None:
+            jit_note = f"failed: {r.stderr[-200:]}"
+    except subprocess.TimeoutExpired:
+        jit_note = (
+            "unavailable: host callbacks (io_callback) hang through "
+            "the tunneled remote device; use the host-side gather + "
+            "device_put path on this deployment"
+        )
 
     results["sparse_kv"] = {
         "dim": dim,
         "batch_keys": B,
         "table_rows": len(table),
         "host_gather_Mlookups_per_s": round(B / host_dt / 1e6, 3),
-        "jit_gather_Mlookups_per_s": round(B / jit_dt / 1e6, 3),
-        "io_callback_overhead_ms": round(
-            (jit_dt - host_dt) * 1e3, 2
-        ),
         "sparse_step_per_s": round(1.0 / step_dt, 2),
+        "sparse_Mlookups_per_s": round(B / step_dt / 1e6, 3),
         "bytes_per_gather_mb": round(B * dim * 4 / 2**20, 2),
+        "jit_gather_Mlookups_per_s": (
+            round(B / jit_dt / 1e6, 3) if jit_dt else None
+        ),
+        "io_callback_overhead_ms": (
+            round((jit_dt - host_dt) * 1e3, 2) if jit_dt else None
+        ),
+        "jit_gather_note": jit_note,
     }
 
 
@@ -1146,6 +1268,16 @@ def main() -> int:
             break
         except Exception as e:  # noqa: BLE001
             results["gqa_attention_kernel_error"] = (
+                f"{type(e).__name__}: {e}"
+            )
+            time.sleep(5)
+    for attempt in (1, 2):
+        try:
+            bench_input_pipeline(jax, results)
+            results.pop("input_pipeline_error", None)
+            break
+        except Exception as e:  # noqa: BLE001
+            results["input_pipeline_error"] = (
                 f"{type(e).__name__}: {e}"
             )
             time.sleep(5)

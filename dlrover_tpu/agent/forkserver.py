@@ -78,6 +78,16 @@ def _template_main(req_fd: int, ev_fd: int):
     ev = os.fdopen(ev_fd, "w")
     children: Dict[int, bool] = {}
     lock = threading.Lock()
+    # text IO objects are not thread-safe: the reap loop and the
+    # spawn loop both emit event lines, and an interleaved write
+    # would be dropped by the agent's JSON reader — losing a
+    # "spawned" (spawn() times out) or an "exit" (stop hangs)
+    ev_lock = threading.Lock()
+
+    def emit(msg: Dict):
+        with ev_lock:
+            ev.write(json.dumps(msg) + "\n")
+            ev.flush()
 
     def reap_loop():
         while True:
@@ -95,10 +105,7 @@ def _template_main(req_fd: int, ev_fd: int):
                     )
                     with lock:
                         children.pop(pid, None)
-                    ev.write(json.dumps(
-                        {"event": "exit", "pid": pid, "code": code}
-                    ) + "\n")
-                    ev.flush()
+                    emit({"event": "exit", "pid": pid, "code": code})
             time.sleep(0.05)
 
     threading.Thread(target=reap_loop, daemon=True).start()
@@ -118,12 +125,33 @@ def _template_main(req_fd: int, ev_fd: int):
                 _sync_jax_config_from_env()
                 argv = spec["argv"]
                 sys.argv = list(argv)
+                # match cold-spawn import semantics: `python x.py`
+                # puts the script's dir at sys.path[0], and the
+                # per-spawn PYTHONPATH never reaches an
+                # already-running interpreter by itself
+                script_dir = os.path.dirname(
+                    os.path.abspath(argv[0])
+                )
+                extra = spec["env"].get("PYTHONPATH", "").split(
+                    os.pathsep
+                )
+                for p in [x for x in extra if x][::-1] + [script_dir]:
+                    if p not in sys.path:
+                        sys.path.insert(0, p)
                 import runpy
 
                 runpy.run_path(argv[0], run_name="__main__")
                 os._exit(0)
             except SystemExit as e:
-                os._exit(int(e.code or 0))
+                code = e.code
+                if code is None:
+                    os._exit(0)
+                if isinstance(code, int):
+                    os._exit(code & 0xFF)
+                # sys.exit("message") semantics: message to stderr,
+                # status 1 (what a cold interpreter does)
+                print(code, file=sys.stderr)
+                os._exit(1)
             except Exception:  # noqa: BLE001
                 import traceback
 
@@ -131,8 +159,7 @@ def _template_main(req_fd: int, ev_fd: int):
                 os._exit(1)
         with lock:
             children[pid] = True
-        ev.write(json.dumps({"event": "spawned", "pid": pid}) + "\n")
-        ev.flush()
+        emit({"event": "spawned", "pid": pid})
     # agent went away: leave children to the reaper of last resort
     os._exit(0)
 

@@ -1,0 +1,193 @@
+"""Standalone cluster monitor feeding the Brain datastore.
+
+Reference: the Go k8smonitor (``go/brain/cmd/k8smonitor/main.go`` +
+``pkg/platform/k8s/watcher``): a deployment-level process — NOT tied
+to any one job master — that watches cluster pod events and persists
+them so the Brain's optimizers learn from every job that ever ran,
+including jobs whose masters died.  TPU rebuild: a watch-driven loop
+over :class:`~dlrover_tpu.scheduler.kubernetes.K8sClient` (real or
+mock API), aggregating per-job pod state into the sqlite datastore
+(``brain/datastore.py``) as metric rows tagged with the lifecycle
+event that produced them.
+
+Runnable standalone::
+
+    python -m dlrover_tpu.brain.cluster_monitor \
+        --namespace prod --db /var/lib/dlrover/brain.db
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.brain.service import JobMetricRecord
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class JobState:
+    """Aggregated live view of one job's pods."""
+
+    job_name: str
+    running: int = 0
+    pending: int = 0
+    failed: int = 0
+    succeeded: int = 0
+    relaunches: int = 0
+    oom_kills: int = 0
+    first_seen: float = field(default_factory=time.time)
+    pod_phase: Dict[str, str] = field(default_factory=dict)
+
+
+class ClusterMonitor:
+    """Watch-driven pod-event aggregator (reference: the k8s watcher
+    manager's pod event handlers feeding the datastore)."""
+
+    def __init__(
+        self,
+        client,
+        store,
+        label_selector: str = "app=dlrover-tpu",
+        snapshot_interval: float = 60.0,
+    ):
+        self._client = client
+        self._store = store
+        self._selector = label_selector
+        self._interval = snapshot_interval
+        self._jobs: Dict[str, JobState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+
+    # -- event handling -----------------------------------------------------
+
+    @staticmethod
+    def _job_of(pod: Dict) -> Optional[str]:
+        labels = pod.get("metadata", {}).get("labels") or {}
+        return labels.get("job") or labels.get("elasticjob-name")
+
+    def handle_event(self, etype: str, pod: Dict):
+        job_name = self._job_of(pod)
+        if not job_name:
+            return
+        name = pod.get("metadata", {}).get("name", "")
+        phase = (pod.get("status") or {}).get("phase", "")
+        reason = (pod.get("status") or {}).get("reason", "")
+        with self._lock:
+            js = self._jobs.setdefault(job_name, JobState(job_name))
+            prev = js.pod_phase.get(name, "")
+            js.pod_phase[name] = phase
+            if phase == prev:
+                return
+            if phase == "Failed":
+                js.failed += 1
+                if "oom" in reason.lower():
+                    js.oom_kills += 1
+            elif phase == "Succeeded":
+                js.succeeded += 1
+            elif etype == "added" and prev == "" and (
+                js.failed + js.succeeded
+            ) > 0:
+                # a new pod after deaths = a relaunch
+                js.relaunches += 1
+            self._persist_locked(js, event=f"{etype}:{phase or '-'}")
+
+    def _persist_locked(self, js: JobState, event: str):
+        counts = {"Running": 0, "Pending": 0}
+        for ph in js.pod_phase.values():
+            if ph in counts:
+                counts[ph] += 1
+        js.running = counts["Running"]
+        js.pending = counts["Pending"]
+        self._store.persist(
+            JobMetricRecord(
+                job_name=js.job_name,
+                timestamp=time.time(),
+                workers=js.running,
+                finished=bool(
+                    js.succeeded and not js.running and not js.pending
+                ),
+            ),
+            event=event,
+            failed=js.failed,
+            relaunches=js.relaunches,
+            oom_kills=js.oom_kills,
+        )
+
+    # -- loops --------------------------------------------------------------
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            try:
+                for etype, pod in self._client.watch_pods(
+                    self._selector
+                ):
+                    if self._stop.is_set():
+                        return
+                    try:
+                        self.handle_event(etype, pod)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("pod event handling failed")
+            except Exception as e:  # noqa: BLE001
+                logger.warning("cluster watch error: %s; rewatch", e)
+            self._stop.wait(1.0)
+
+    def _snapshot_loop(self):
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                for js in self._jobs.values():
+                    self._persist_locked(js, event="snapshot")
+
+    def start(self):
+        for target, name in (
+            (self._watch_loop, "cluster-watch"),
+            (self._snapshot_loop, "cluster-snapshot"),
+        ):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+
+    def job_states(self) -> Dict[str, JobState]:
+        with self._lock:
+            return dict(self._jobs)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from dlrover_tpu.brain.datastore import SqliteJobMetricsStore
+    from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+    parser = argparse.ArgumentParser(
+        description="DLRover cluster monitor -> Brain datastore"
+    )
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--db", default="brain_metrics.db")
+    parser.add_argument("--selector", default="app=dlrover-tpu")
+    parser.add_argument("--snapshot-interval", type=float, default=60.0)
+    args = parser.parse_args(argv)
+    client = K8sClient(namespace=args.namespace)
+    store = SqliteJobMetricsStore(args.db)
+    mon = ClusterMonitor(
+        client, store, label_selector=args.selector,
+        snapshot_interval=args.snapshot_interval,
+    )
+    mon.start()
+    logger.info(
+        "cluster monitor watching %s (selector %s) -> %s",
+        args.namespace, args.selector, args.db,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

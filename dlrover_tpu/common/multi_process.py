@@ -331,6 +331,19 @@ class PersistentSharedMemory(shared_memory.SharedMemory):
             pass
         super().unlink()
 
+    def close(self):
+        """Like the base close, but tolerant of still-exported buffer
+        views: a consumer (e.g. a zero-copy device_put alias or a
+        lingering np.frombuffer view awaiting GC) keeping the mapping
+        alive is not an error for our lifecycle — the mapping dies
+        with the last reference; without this, interpreter-shutdown
+        ``__del__`` spews ``BufferError: cannot close exported
+        pointers exist`` tracebacks."""
+        try:
+            super().close()
+        except BufferError:
+            pass
+
 
 def get_or_create_shm(name: str, size: int) -> PersistentSharedMemory:
     """Attach to ``name`` if it exists with sufficient size, else

@@ -218,6 +218,16 @@ long kv_export(void* handle, int64_t* keys_out, float* values_out,
   return n;
 }
 
+// Frequency column only: eviction-threshold math on a big table must
+// not force the caller to materialize the whole [n, dim] value matrix.
+long kv_export_freq(void* handle, uint64_t* freq_out, long max_n) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  long n = std::min<long>(max_n, static_cast<long>(t->freq.size()));
+  for (long i = 0; i < n; ++i) freq_out[i] = t->freq[i];
+  return n;
+}
+
 void kv_import(void* handle, const int64_t* keys, const float* vals,
                const uint64_t* freqs, long n) {
   Table* t = static_cast<Table*>(handle);

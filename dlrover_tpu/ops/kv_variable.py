@@ -55,6 +55,10 @@ def _load():
         lib.kv_export.argtypes = [
             ctypes.c_void_p, i64p, f32p, u64p, ctypes.c_long,
         ]
+        lib.kv_export_freq.restype = ctypes.c_long
+        lib.kv_export_freq.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_long,
+        ]
         lib.kv_import.argtypes = [
             ctypes.c_void_p, i64p, f32p, u64p, ctypes.c_long,
         ]
@@ -172,19 +176,44 @@ class KvVariable:
 
     def evict_to_capacity(self, max_rows: int) -> int:
         """Frequency-ordered overflow policy: evict coldest rows until
-        at most ``max_rows`` remain (reference: the kv-variable
+        ~``max_rows`` remain (reference: the kv-variable
         frequency/overflow policies, tfplus
-        kv_variable_ops.cc:37 / kernels/kv_variable.h:89).  The
-        threshold is the (n - max_rows)-th smallest frequency; ties at
-        the threshold may keep the table slightly under budget (every
-        row at the cutoff is evicted) — never over."""
+        kv_variable_ops.cc:37 / kernels/kv_variable.h:89).
+
+        Ties at the threshold are kept WHOLE: evicting a frequency
+        class is all-or-nothing, so the cutoff backs off until at
+        least one row survives — the table may stay over budget when
+        a tie class straddles it, but learned state is never wiped
+        (an all-equal-frequency table, e.g. epoch one, evicts
+        nothing).  Only the frequency column is exported for the
+        threshold computation."""
         n = len(self)
         if n <= max_rows:
             return 0
-        _, _, freq = self.export()
+        freq = self.export_freq()
         order = np.sort(freq)
         cutoff = int(order[n - max_rows - 1]) + 1
+        # rows surviving this cutoff; back off while it would wipe
+        # the table (tie class at the top)
+        while cutoff > 0:
+            keep = n - int(np.searchsorted(order, cutoff, "left"))
+            if keep > 0:
+                break
+            cutoff -= 1
+        if cutoff <= 0 or n - int(
+            np.searchsorted(order, cutoff, "left")
+        ) == n:
+            return 0  # nothing evictable without losing a whole class
         return self.evict_below(cutoff)
+
+    def export_freq(self) -> np.ndarray:
+        """Frequency column only — no key/value materialization (an
+        eviction decision on a big table must not allocate the whole
+        embedding matrix)."""
+        n = len(self)
+        freq = np.empty(n, dtype=np.uint64)
+        got = self._lib.kv_export_freq(self._handle, _u64(freq), n)
+        return freq[:got]
 
     def export(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         n = len(self)
@@ -214,6 +243,14 @@ class KvVariable:
     def jax_gather(self, keys, insert_missing: bool = True):
         """Embed a host gather inside a jitted program; output is a
         dense [n, dim] f32 array on device.
+
+        Platform note: host callbacks require the runtime to call
+        back into THIS process mid-program.  A tunneled remote
+        device (device server on the far side of a network link)
+        cannot — the call hangs.  There, run the gather host-side
+        and ``device_put`` the dense batch instead (the embedding
+        lookup is host-resident by design, like the reference's CPU
+        parameter-server tables).
 
         The default gather mutates the table (inserts missing rows and
         bumps frequency counters), so it runs through

@@ -1,0 +1,93 @@
+"""Standalone Brain cluster monitor (reference:
+``go/brain/cmd/k8smonitor/main.go`` + the k8s watcher manager): pod
+lifecycle events across ALL jobs feed the datastore, independent of
+any job master."""
+
+import time
+
+from dlrover_tpu.brain.cluster_monitor import ClusterMonitor
+from dlrover_tpu.brain.datastore import SqliteJobMetricsStore
+from dlrover_tpu.scheduler.kubernetes import K8sClient, MockK8sApi
+
+
+def _pod(name, job, phase="Pending", reason=""):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {"app": "dlrover-tpu", "job": job},
+        },
+        "status": {"phase": phase, "reason": reason},
+    }
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_cluster_monitor_aggregates_multi_job_lifecycle():
+    api = MockK8sApi()
+    client = K8sClient(namespace="test", api=api)
+    store = SqliteJobMetricsStore(":memory:")
+    mon = ClusterMonitor(client, store, snapshot_interval=3600)
+    mon.start()
+    try:
+        # two independent jobs on one cluster
+        api.create_pod("test", _pod("a-0", "job-a"))
+        api.create_pod("test", _pod("a-1", "job-a"))
+        api.create_pod("test", _pod("b-0", "job-b"))
+        api.set_pod_phase("a-0", "Running")
+        api.set_pod_phase("a-1", "Running")
+        api.set_pod_phase("b-0", "Running")
+        assert _wait(lambda: (
+            "job-a" in mon.job_states()
+            and mon.job_states()["job-a"].running == 2
+        ))
+        # job-a loses a pod to OOM, gets a replacement
+        api.set_pod_phase("a-1", "Failed", reason="OOMKilled")
+        assert _wait(
+            lambda: mon.job_states()["job-a"].oom_kills == 1
+        )
+        api.create_pod("test", _pod("a-2", "job-a"))
+        api.set_pod_phase("a-2", "Running")
+        assert _wait(
+            lambda: mon.job_states()["job-a"].relaunches == 1
+        )
+        # job-b finishes cleanly
+        api.set_pod_phase("b-0", "Succeeded")
+        assert _wait(
+            lambda: mon.job_states()["job-b"].succeeded == 1
+        )
+        # the datastore saw every job, with event provenance
+        names = set(store.job_names())
+        assert {"job-a", "job-b"} <= names
+        recs = store.load(job_name="job-a")
+        assert recs
+        # latest job-a record reflects 2 running after the relaunch
+        assert recs[-1].workers == 2
+        done = store.load(job_name="job-b")[-1]
+        assert done.finished
+    finally:
+        mon.stop()
+
+
+def test_cluster_monitor_ignores_unlabeled_pods():
+    api = MockK8sApi()
+    client = K8sClient(namespace="test", api=api)
+    store = SqliteJobMetricsStore(":memory:")
+    mon = ClusterMonitor(client, store, snapshot_interval=3600)
+    mon.start()
+    try:
+        api.create_pod("test", {
+            "metadata": {"name": "x", "labels": {}},
+            "status": {"phase": "Running"},
+        })
+        api.create_pod("test", _pod("a-0", "job-a", phase="Running"))
+        assert _wait(lambda: "job-a" in mon.job_states())
+        assert set(mon.job_states()) == {"job-a"}
+    finally:
+        mon.stop()

@@ -202,3 +202,26 @@ def test_evict_to_capacity_noop_under_budget():
     kv.gather(np.arange(50, dtype=np.int64))
     assert kv.evict_to_capacity(100) == 0
     assert len(kv) == 50
+
+
+def test_evict_to_capacity_never_wipes_tied_table():
+    """All-equal frequencies (e.g. first epoch): evicting the tie
+    class would wipe every learned embedding — the policy must keep
+    the class whole and stay over budget instead."""
+    kv = KvVariable(dim=4)
+    kv.gather(np.arange(1000, dtype=np.int64))  # all freq == 1
+    assert kv.evict_to_capacity(100) == 0
+    assert len(kv) == 1000
+    # once a hot subset separates, eviction works again
+    kv.gather(np.arange(50, dtype=np.int64))
+    assert kv.evict_to_capacity(100) == 950
+    assert len(kv) == 50
+
+
+def test_export_freq_matches_export():
+    kv = KvVariable(dim=4)
+    kv.gather(np.arange(20, dtype=np.int64))
+    kv.gather(np.arange(5, dtype=np.int64))
+    _, _, full = kv.export()
+    only = kv.export_freq()
+    assert sorted(full.tolist()) == sorted(only.tolist())
