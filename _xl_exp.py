@@ -1,0 +1,49 @@
+"""XL batch/remat variants to chase >42% MFU."""
+import time
+from functools import partial
+import jax, jax.numpy as jnp, numpy as np, optax
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_tpu.optim import q_adamw
+from dlrover_tpu.trainer.elastic_trainer import TrainState
+
+peak, seq = 197e12, 1024
+
+def run(tag, batch, remat):
+    cfg = GPTConfig(num_layers=48, num_heads=25, hidden_dim=1600,
+                    max_seq_len=seq, attention_impl="flash",
+                    remat=remat, param_dtype=jnp.bfloat16)
+    model = GPT(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=seq)
+    opt = q_adamw(learning_rate=3e-4, weight_decay=0.1)
+    state = TrainState.create(params, opt)
+    n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p, t: cross_entropy_loss(
+                model.apply({"params": p}, t[:, :-1]), t[:, 1:]))(state.params, tokens)
+        upd, no = opt.update(grads, state.opt_state, state.params)
+        return TrainState(params=optax.apply_updates(state.params, upd),
+                          opt_state=no, step=state.step + 1), loss
+
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32))
+    try:
+        state, loss = step(state, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(8):
+            state, loss = step(state, tokens)
+        float(loss)
+        dt = (time.perf_counter() - t0) / 8
+        tps = batch * seq / dt
+        fpt = 6 * n + 12 * cfg.num_layers * seq * cfg.hidden_dim
+        print(f"{tag}: {dt*1e3:.0f} ms, {tps:,.0f} tok/s, MFU {fpt*tps/peak:.4f}", flush=True)
+    except Exception as e:
+        print(f"{tag}: FAIL {type(e).__name__}", flush=True)
+
+run("b4+remat (current)", 4, True)
+run("b8+remat", 8, True)
+run("b6+remat", 6, True)
+run("b4 no-remat", 4, False)

@@ -775,21 +775,26 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     # the timed region on FRESH arrays — a real sync save always pays
     # it (round 2 warmed jax's host cache first, hiding ~90% of the
     # cost and making the async path look pathologically slow against
-    # a fake 10s number).
-    fresh = jax.jit(lambda t: jax.tree.map(lambda x: x + 0, t))(
-        state_dict
-    )
-    jax.block_until_ready(fresh)
+    # a fake 10s number).  Measured TWICE — before and after the
+    # flash saves — and averaged: the device link's bandwidth drifts
+    # minute to minute, and a single sample makes the
+    # snapshot-vs-sync ratio a coin flip.
     sync_dir = os.path.join(workdir, "sync")
     os.makedirs(sync_dir, exist_ok=True)
-    t0 = time.perf_counter()
-    host_state = jax.device_get(fresh)
-    t_d2h = time.perf_counter() - t0
-    with open(os.path.join(sync_dir, "ckpt.pkl"), "wb") as f:
-        pickle.dump(host_state, f)
-    f_sync = time.perf_counter() - t0
-    del host_state, fresh
-    d2h_mbps = state_bytes / 2**20 / max(t_d2h, 1e-9)
+
+    def sync_save():
+        fresh = jax.jit(
+            lambda t: jax.tree.map(lambda x: x + 0, t)
+        )(state_dict)
+        float(jax.tree_util.tree_leaves(fresh)[0].ravel()[0])
+        t0 = time.perf_counter()
+        host_state = jax.device_get(fresh)
+        t_d2h = time.perf_counter() - t0
+        with open(os.path.join(sync_dir, "ckpt.pkl"), "wb") as f:
+            pickle.dump(host_state, f)
+        return time.perf_counter() - t0, t_d2h
+
+    f_sync_pre, t_d2h = sync_save()
 
     # -- separate agent process hosting the async saver
     env = dict(os.environ)
@@ -847,8 +852,14 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
         agent.kill()
         agent.wait()
 
+    f_sync_post, _ = sync_save()
+    f_sync = (f_sync_pre + f_sync_post) / 2
+    d2h_mbps = state_bytes / 2**20 / max(t_d2h, 1e-9)
     results["flash_ckpt"] = {
         "sync_save_s": round(f_sync, 3),
+        "sync_save_pre_post_s": [
+            round(f_sync_pre, 3), round(f_sync_post, 3),
+        ],
         "sync_d2h_s": round(t_d2h, 3),
         "d2h_MBps": round(d2h_mbps, 1),
         "flash_stall_s": round(f_flash, 4),
@@ -1133,7 +1144,11 @@ def bench_goodput_churn(results: dict, workdir: str):
 
     entries = read_progress(progress)
     distinct = len({step for _, step in entries})
-    goodput_pct = 100.0 * distinct / max(1.0, wall * clean_rate)
+    goodput_raw = 100.0 * distinct / max(1.0, wall * clean_rate)
+    # >100% means the churn run outpaced the (sampled) calibration
+    # rate — calibration noise, not free work; clamp the headline and
+    # keep the raw ratio visible
+    goodput_pct = min(100.0, goodput_raw)
 
     # SpeedMonitor cross-check: replay first-completion step reports
     from dlrover_tpu.master.speed_monitor import SpeedMonitor
@@ -1153,6 +1168,7 @@ def bench_goodput_churn(results: dict, workdir: str):
 
     results["goodput"] = {
         "goodput_pct": round(goodput_pct, 1),
+        "goodput_raw_pct": round(goodput_raw, 1),
         "speed_monitor_goodput_pct": round(100 * sm_goodput, 1),
         "duration_s": round(wall, 1),
         "kill_every_s": kill_every,
@@ -1207,14 +1223,55 @@ def bench_elastic_recovery(results: dict, workdir: str):
     }
 
 
+def _emit(results: dict, speedup: float):
+    print(
+        json.dumps(
+            {
+                "metric": "flash_ckpt_stall_speedup_vs_sync_save",
+                "value": round(speedup, 2),
+                "unit": "x",
+                # reference claims ~10x vs sync NVMe save
+                "vs_baseline": round(speedup / 10.0, 3),
+                "detail": results,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> int:
     workdir = tempfile.mkdtemp(prefix="dlrover_bench_")
     os.environ.setdefault(
         "DLROVER_SHARED_DIR", os.path.join(workdir, "sockets")
     )
+    import threading
+
     import jax
 
     results = {"platform": jax.devices()[0].platform}
+
+    # the remote-device tunnel can HANG silently mid-transfer (not
+    # just error); a hung section must not eat the whole run — after
+    # the deadline, emit whatever was measured and exit
+    deadline_s = float(os.getenv("BENCH_DEADLINE_S", "5400"))
+    done_evt = threading.Event()
+
+    def watchdog():
+        if done_evt.wait(deadline_s):
+            return
+        results["watchdog"] = (
+            f"bench exceeded {deadline_s:.0f}s; emitting partial "
+            "results (a tunnel transfer likely hung)"
+        )
+        speedup = results.pop("_speedup", 0.0)
+        _emit(results, speedup)
+        # exit 0 deliberately: an rc-gating harness that discards
+        # output on failure would lose the partial results; the
+        # "watchdog" key marks the run as abnormal for any consumer
+        # that reads the JSON
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
     # the tunnel backend occasionally drops a connection mid-compile;
     # one retry distinguishes transient infra from real failures
     for attempt in (1, 2):
@@ -1292,6 +1349,7 @@ def main() -> int:
     speedup = 0.0
     try:
         speedup = bench_flash_ckpt(jax, results, workdir)
+        results["_speedup"] = speedup
     except Exception as e:  # noqa: BLE001
         results["flash_ckpt_error"] = f"{type(e).__name__}: {e}"
     try:
@@ -1304,19 +1362,9 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             results["goodput_error"] = f"{type(e).__name__}: {e}"
     shutil.rmtree(workdir, ignore_errors=True)
-
-    print(
-        json.dumps(
-            {
-                "metric": "flash_ckpt_stall_speedup_vs_sync_save",
-                "value": round(speedup, 2),
-                "unit": "x",
-                # reference claims ~10x vs sync NVMe save
-                "vs_baseline": round(speedup / 10.0, 3),
-                "detail": results,
-            }
-        )
-    )
+    done_evt.set()
+    results.pop("_speedup", None)
+    _emit(results, speedup)
     return 0
 
 

@@ -62,6 +62,19 @@ def _sync_jax_config_from_env():
             pass
 
 
+def _flush_and_exit(code: int):
+    """``os._exit`` skips interpreter shutdown, which is exactly what
+    a forked worker needs (no atexit/thread teardown of the template's
+    state) — but it also skips the std-stream flush a cold interpreter
+    performs, silently dropping the worker's buffered output."""
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001
+        pass
+    os._exit(code)
+
+
 def _template_main(req_fd: int, ev_fd: int):
     """Runs inside the template process (see __main__ below)."""
     for mod in os.environ.get(
@@ -141,22 +154,22 @@ def _template_main(req_fd: int, ev_fd: int):
                 import runpy
 
                 runpy.run_path(argv[0], run_name="__main__")
-                os._exit(0)
+                _flush_and_exit(0)
             except SystemExit as e:
                 code = e.code
                 if code is None:
-                    os._exit(0)
+                    _flush_and_exit(0)
                 if isinstance(code, int):
-                    os._exit(code & 0xFF)
+                    _flush_and_exit(code & 0xFF)
                 # sys.exit("message") semantics: message to stderr,
                 # status 1 (what a cold interpreter does)
                 print(code, file=sys.stderr)
-                os._exit(1)
+                _flush_and_exit(1)
             except Exception:  # noqa: BLE001
                 import traceback
 
                 traceback.print_exc()
-                os._exit(1)
+                _flush_and_exit(1)
         with lock:
             children[pid] = True
         emit({"event": "spawned", "pid": pid})

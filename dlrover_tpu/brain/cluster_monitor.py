@@ -77,6 +77,21 @@ class ClusterMonitor:
         with self._lock:
             js = self._jobs.setdefault(job_name, JobState(job_name))
             prev = js.pod_phase.get(name, "")
+            if etype == "deleted":
+                # the pod is GONE whatever its last phase said — a
+                # deletion while Running/Pending (preemption,
+                # scale-down) is a loss, and leaving the stale phase
+                # in place would report workers=N forever and block
+                # 'finished' for every normally-torn-down job
+                js.pod_phase.pop(name, None)
+                if prev in ("Running", "Pending"):
+                    js.failed += 1
+                    if "oom" in reason.lower():
+                        js.oom_kills += 1
+                self._persist_locked(
+                    js, event=f"deleted:{prev or phase or '-'}"
+                )
+                return
             js.pod_phase[name] = phase
             if phase == prev:
                 return

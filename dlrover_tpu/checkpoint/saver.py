@@ -265,6 +265,25 @@ class AsyncCheckpointSaver:
         unchanged because the copy is taken under the lock (reference
         lock protocol: _save_shard, ckpt_saver.py:558-574)."""
         lock = self._shm_locks[local_rank]
+        # prefault the segment BEFORE taking the lock: the agent's
+        # first touch of a multi-GB mapping page-faults the whole
+        # range, and doing that inside the lock stalls the trainer's
+        # next snapshot for ~10 s/GB on slow hosts.  A lock-free
+        # read-only touch is safe — the data read is discarded; only
+        # the page mappings persist.
+        try:
+            meta = handler.metadata()
+            if meta:
+                total = meta["scalar_offset"] + meta["scalar_nbytes"]
+                shm = handler._attach(min_size=total)
+                if shm is not None:
+                    import numpy as _np
+
+                    _np.frombuffer(
+                        shm.buf, dtype=_np.uint8, count=total
+                    )[::4096].sum()
+        except Exception:  # noqa: BLE001 - best-effort warmup
+            pass
         acquired = lock.acquire(timeout=60.0)
         if not acquired:
             # reading shm unlocked races the trainer's next save; a torn

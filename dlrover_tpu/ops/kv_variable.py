@@ -195,14 +195,13 @@ class KvVariable:
         cutoff = int(order[n - max_rows - 1]) + 1
         # rows surviving this cutoff; back off while it would wipe
         # the table (tie class at the top)
+        keep = 0
         while cutoff > 0:
             keep = n - int(np.searchsorted(order, cutoff, "left"))
             if keep > 0:
                 break
             cutoff -= 1
-        if cutoff <= 0 or n - int(
-            np.searchsorted(order, cutoff, "left")
-        ) == n:
+        if cutoff <= 0 or keep == n:
             return 0  # nothing evictable without losing a whole class
         return self.evict_below(cutoff)
 

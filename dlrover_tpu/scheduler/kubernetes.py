@@ -169,6 +169,13 @@ class MockK8sApi(K8sApi):
         return tok
 
     def _emit(self, event: tuple):
+        import copy
+
+        # deep-copy the payload: emitters pass dict(pod), but the
+        # nested status dict stays SHARED with the live pod object —
+        # a later set_pod_phase/delete_pod would rewrite the phase
+        # inside events still sitting in consumer queues
+        event = (event[0], copy.deepcopy(event[1]))
         with self._watch_lock:
             item = (self._seq, event)
             self._seq += 1

@@ -91,3 +91,42 @@ def test_cluster_monitor_ignores_unlabeled_pods():
         assert set(mon.job_states()) == {"job-a"}
     finally:
         mon.stop()
+
+
+def test_cluster_monitor_handles_deleted_pods():
+    """A pod deleted while Running (preemption / scale-down) leaves
+    the running count; a job whose last pods are deleted after
+    success still finishes."""
+    api = MockK8sApi()
+    client = K8sClient(namespace="test", api=api)
+    store = SqliteJobMetricsStore(":memory:")
+    mon = ClusterMonitor(client, store, snapshot_interval=3600)
+    mon.start()
+    try:
+        api.create_pod("test", _pod("c-0", "job-c"))
+        api.create_pod("test", _pod("c-1", "job-c"))
+        api.set_pod_phase("c-0", "Running")
+        api.set_pod_phase("c-1", "Running")
+        assert _wait(lambda: (
+            "job-c" in mon.job_states()
+            and mon.job_states()["job-c"].running == 2
+        ))
+        api.delete_pod("test", "c-1")
+        assert _wait(lambda: mon.job_states()["job-c"].running == 1)
+        assert mon.job_states()["job-c"].failed >= 1
+        # replacement after a deletion counts as a relaunch
+        api.create_pod("test", _pod("c-2", "job-c"))
+        api.set_pod_phase("c-2", "Running")
+        assert _wait(
+            lambda: mon.job_states()["job-c"].relaunches == 1
+        )
+        # clean finish: succeed then delete everything
+        api.set_pod_phase("c-0", "Succeeded")
+        api.set_pod_phase("c-2", "Succeeded")
+        api.delete_pod("test", "c-0")
+        api.delete_pod("test", "c-2")
+        assert _wait(
+            lambda: store.load(job_name="job-c")[-1].finished
+        )
+    finally:
+        mon.stop()

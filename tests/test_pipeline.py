@@ -584,3 +584,47 @@ def test_pipelined_gpt_uneven_layer_split():
                      grads["blocks"])
     )
     assert max(live) > 0.0
+
+
+def test_1f1b_eight_stages_exact():
+    """Every device a stage (8 stages on the 8-device mesh),
+    microbatches > stages: the deepest pipeline this mesh can
+    express stays gradient-exact."""
+    from dlrover_tpu.parallel.pipeline import pipeline_train_step_1f1b
+
+    mesh8 = build_mesh(MeshConfig(data=-1, pipeline=8))
+    S, M = 8, 12
+    stages = _stages(n=S, seed=60)
+    x = jax.random.normal(jax.random.PRNGKey(61), (24, 8))
+    y = jax.random.normal(jax.random.PRNGKey(62), (24, 8))
+
+    def loss_fn(out, y_mb):
+        return jnp.mean((out - y_mb) ** 2)
+
+    def seq_loss(stacked):
+        micro_x = x.reshape(M, -1, 8)
+        micro_y = y.reshape(M, -1, 8)
+        total = 0.0
+        for m in range(M):
+            h = micro_x[m]
+            for i in range(S):
+                h = _stage_fn(
+                    jax.tree.map(lambda p: p[i], stacked), h
+                )
+            total = total + loss_fn(h, micro_y[m])
+        return total / M
+
+    stacked = stack_stage_params(stages)
+    l_ref, g_ref = jax.value_and_grad(seq_loss)(stacked)
+    res = pipeline_train_step_1f1b(
+        _stage_fn, loss_fn, stacked, x, y, mesh8,
+        num_microbatches=M,
+    )
+    np.testing.assert_allclose(float(res.loss), float(l_ref),
+                               rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        res.stage_grads, g_ref,
+    )
