@@ -182,9 +182,11 @@ def build_from_plan(
         # schedule) with blockwise low-bit AdamW — the optimizer
         # family is a searchable dimension like the reference's
         # q_adamw swap, but hyperparameters come from the strategy
-        # config, not the user's optax chain.  Pin your optimizer by
-        # setting context.extra["search_optimizer"] = False (the
-        # search then never emits low_bit_opt).
+        # config, not the user's optax chain.  The search only emits
+        # low_bit_opt candidates when the user opts in with
+        # context.extra["search_optimizer"] = True; hyperparams can
+        # be pinned via the strategy config ("learning_rate" accepts
+        # an optax schedule).
         logger.warning(
             "low_bit_opt: replacing the user optimizer with "
             "q_adamw(bits=%d, %s)",
@@ -377,6 +379,10 @@ def auto_accelerate(
     dry_run_candidates: bool = True,
     devices=None,
     grad_accum: int = 1,
+    extra: Optional[Dict] = None,
+    rank_mode: str = "profile",
+    profile_top_k: int = 1,
+    cost_budget: int = 0,
 ) -> AccelerateResult:
     """Pick (or load) a strategy and compile the sharded train step.
 
@@ -384,10 +390,17 @@ def auto_accelerate(
     generated, memory-pruned, optionally dry-run profiled, and the
     fastest is kept (reference flow: auto/accelerate.py:406 +
     engine executor task loop).
+
+    ``extra`` feeds ``ModelContext.extra`` — e.g.
+    ``{"search_optimizer": True}`` opts in to the int8-moment
+    optimizer swap, ``{"optimizer_hyperparams": {...}}`` carries the
+    user's lr schedule into it.  ``rank_mode``/``profile_top_k``/
+    ``cost_budget`` select the search tier (see
+    :func:`dlrover_tpu.accel.strategy_search.search_strategy`).
     """
     context = ModelContext(
         model=model, optim_factory=optim_factory, loss_fn=loss_fn,
-        sample_batch=sample_batch,
+        sample_batch=sample_batch, extra=dict(extra or {}),
     )
     lib = OptimizationLibrary()
     devices = list(devices) if devices is not None else jax.devices()
@@ -407,6 +420,8 @@ def auto_accelerate(
                 context, len(devices), devices=devices,
                 grad_accums=(grad_accum,) if grad_accum > 1
                 else (1, 2),
+                rank_mode=rank_mode, profile_top_k=profile_top_k,
+                cost_budget=cost_budget,
             )
             strategy = result.best.strategy
             if grad_accum == 1:

@@ -208,9 +208,24 @@ class LowBitOptimizerOpt(Optimization):
 
     def apply(self, plan, config, context=None):
         plan.low_bit_opt = int(config.get("bits", 8))
+        # the user can carry their own hyperparams into the swapped
+        # optimizer (learning_rate accepts an optax schedule callable,
+        # so an existing warmup/cosine schedule survives the swap)
+        user_hp = dict(
+            getattr(context, "extra", {}).get(
+                "optimizer_hyperparams", {}
+            )
+        ) if context is not None else {}
+        lr = user_hp.get(
+            "learning_rate", config.get("learning_rate", 3e-4)
+        )
         plan.low_bit_opt_config = {
-            "learning_rate": float(config.get("learning_rate", 3e-4)),
-            "weight_decay": float(config.get("weight_decay", 0.1)),
+            "learning_rate": lr if callable(lr) else float(lr),
+            "weight_decay": float(
+                user_hp.get(
+                    "weight_decay", config.get("weight_decay", 0.1)
+                )
+            ),
         }
         plan.notes.append(
             f"int{plan.low_bit_opt} optimizer moments (q_adamw)"

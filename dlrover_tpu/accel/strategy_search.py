@@ -37,6 +37,7 @@ class Candidate:
     half: bool = False          # bf16 param storage
     low_bit_opt: bool = False   # int8 optimizer moments
     step_time_s: Optional[float] = None
+    est_step_time_s: Optional[float] = None  # cost-model rank (hybrid)
 
     def features(self) -> Dict[str, float]:
         return {
@@ -160,11 +161,12 @@ def generate_candidates(
             and analysis.seq_len % tensor == 0
         ):
             variants.append((1, tensor, 1))   # ring sp
-        # int8-moment variants swap the user optimizer for q_adamw
-        # (training-semantics change); opt out via
-        # context.extra["search_optimizer"] = False
+        # int8-moment variants swap the user optimizer for q_adamw —
+        # a training-semantics change (the user's optax chain and LR
+        # schedule are replaced) — so they are OPT-IN:
+        # context.extra["search_optimizer"] = True enables them
         search_opt = bool(
-            getattr(context, "extra", {}).get("search_optimizer", True)
+            getattr(context, "extra", {}).get("search_optimizer", False)
         )
         for tp, sp, ep in variants:
             # precision levels, cheapest-HBM last (the single-chip
@@ -235,6 +237,9 @@ def search_strategy(
     seed: int = 0,
     rank_mode: str = "profile",
     num_slices: int = 1,
+    profile_top_k: int = 1,
+    profile_steps: int = 3,
+    cost_budget: int = 0,
 ) -> SearchResult:
     """Generate, prune, and rank; BO picks what to measure when
     candidates exceed the budget (reference: bayes_opt_sg.py).
@@ -242,15 +247,20 @@ def search_strategy(
     ``rank_mode="profile"`` times real executions (ground truth);
     ``"cost_model"`` compiles only and ranks by XLA's own
     flops/bytes roofline (deterministic, never runs a step — for
-    noisy shared machines or search spaces too big to execute).
-    """
+    noisy shared machines or search spaces too big to execute);
+    ``"hybrid"`` cost-ranks the candidates (all of them, or an even
+    subsample of ``cost_budget`` when set — compiles are chip-free
+    but not free), then profiles only the ``profile_top_k`` best:
+    on-chip time is bounded by k compiles + k × ``profile_steps``
+    steps, not by the candidate count — the production shape for an
+    expensive shared chip."""
     from dlrover_tpu.accel.dry_runner import (
         estimate_plan,
         profile_plan,
     )
     from dlrover_tpu.accel.opt_lib import OptimizationLibrary
 
-    if rank_mode not in ("profile", "cost_model"):
+    if rank_mode not in ("profile", "cost_model", "hybrid"):
         raise ValueError(f"unknown rank_mode {rank_mode!r}")
     lib = OptimizationLibrary()
     analysis = analyse(context)  # one pass, shared with the DCN term
@@ -263,40 +273,99 @@ def search_strategy(
         len(cands), [c.describe() for c in cands],
     )
 
-    def evaluate(cand: Candidate) -> float:
+    def _plan_for(cand: Candidate):
         plan = lib.apply_strategy(cand.strategy, context)
         plan.grad_accum = cand.grad_accum
         if num_slices > 1:
             plan.mesh_config.num_slices = num_slices
-        if rank_mode == "cost_model":
-            result = estimate_plan(plan, context, devices=devices)
-            cand.step_time_s = (
-                result.est_step_time_s if result.ok else float("inf")
-            )
-            if result.ok:
-                # DCN-vs-ICI collective term the compile-only cost
-                # model cannot see on a virtual flat mesh
-                from dlrover_tpu.accel.analyser import comm_cost_s
+        return plan
 
-                cand.step_time_s += comm_cost_s(
-                    analysis, cand.data, cand.fsdp, cand.tensor,
-                    num_slices=num_slices,
-                    grad_accum=cand.grad_accum,
-                    sequence=cand.sequence,
-                    expert=cand.expert,
-                )
-        else:
-            result = profile_plan(plan, context, devices=devices)
-            cand.step_time_s = (
-                result.step_time_s if result.ok else float("inf")
+    def eval_cost(cand: Candidate) -> float:
+        result = estimate_plan(
+            _plan_for(cand), context, devices=devices
+        )
+        cand.est_step_time_s = (
+            result.est_step_time_s if result.ok else float("inf")
+        )
+        if result.ok:
+            # DCN-vs-ICI collective term the compile-only cost
+            # model cannot see on a virtual flat mesh
+            from dlrover_tpu.accel.analyser import comm_cost_s
+
+            cand.est_step_time_s += comm_cost_s(
+                analysis, cand.data, cand.fsdp, cand.tensor,
+                num_slices=num_slices,
+                grad_accum=cand.grad_accum,
+                sequence=cand.sequence,
+                expert=cand.expert,
             )
         logger.info(
-            "candidate %s: ok=%s step=%.4fs (%s)",
-            cand.describe(), result.ok, cand.step_time_s, rank_mode,
+            "candidate %s: ok=%s est=%.4fs (cost_model)",
+            cand.describe(), result.ok, cand.est_step_time_s,
+        )
+        return cand.est_step_time_s
+
+    def eval_profile(cand: Candidate) -> float:
+        result = profile_plan(
+            _plan_for(cand), context,
+            profile_steps=profile_steps, devices=devices,
+        )
+        cand.step_time_s = (
+            result.step_time_s if result.ok else float("inf")
+        )
+        logger.info(
+            "candidate %s: ok=%s step=%.4fs (profile)",
+            cand.describe(), result.ok, cand.step_time_s,
         )
         return cand.step_time_s
 
-    if len(cands) <= dry_run_budget:
+    def evaluate(cand: Candidate) -> float:
+        if rank_mode == "cost_model":
+            cand.step_time_s = eval_cost(cand)
+            return cand.step_time_s
+        return eval_profile(cand)
+
+    if rank_mode == "hybrid":
+        # static tier ranks the space; the chip only pays for the
+        # top-k (reference pitch: the engine's analyzers prune
+        # before the dry-runner executes —
+        # atorch/auto/engine/acceleration_engine.py:13)
+        to_cost = cands
+        if cost_budget and len(cands) > cost_budget:
+            # even deterministic subsample across the generated order
+            # (which walks the factorization x precision x remat grid)
+            stride = len(cands) / cost_budget
+            to_cost = [
+                cands[int(i * stride)] for i in range(cost_budget)
+            ]
+            logger.info(
+                "hybrid search: cost-ranking %d of %d candidates",
+                len(to_cost), len(cands),
+            )
+        for cand in to_cost:
+            eval_cost(cand)
+        ranked = sorted(
+            (
+                c for c in cands
+                if c.est_step_time_s is not None
+                and math.isfinite(c.est_step_time_s)
+            ),
+            key=lambda c: c.est_step_time_s,
+        )
+        # profile down the ranking until top-k have SUCCEEDED (a
+        # candidate that compiles but OOMs on-chip must not end the
+        # search); on-chip work stays bounded at top_k + 2 attempts
+        want = max(1, profile_top_k)
+        attempts = 0
+        ok_profiles = 0
+        for cand in ranked:
+            if ok_profiles >= want or attempts >= want + 2:
+                break
+            attempts += 1
+            if math.isfinite(eval_profile(cand)):
+                ok_profiles += 1
+        measured = list(cands)
+    elif len(cands) <= dry_run_budget:
         for cand in cands:
             evaluate(cand)
         measured = [c for c in cands if c.step_time_s is not None]
@@ -340,6 +409,24 @@ def search_strategy(
         c for c in measured
         if c.step_time_s is not None and math.isfinite(c.step_time_s)
     ]
+    if not runnable and rank_mode == "hybrid":
+        # no profile survived; fall back to the static ranking —
+        # excluding candidates whose on-chip profile already FAILED
+        # (returning a known-broken plan as best would be worse than
+        # an untested one)
+        runnable = [
+            c for c in measured
+            if c.est_step_time_s is not None
+            and math.isfinite(c.est_step_time_s)
+            and c.step_time_s is None
+        ]
+        if runnable:
+            best = min(runnable, key=lambda c: c.est_step_time_s)
+            logger.warning(
+                "strategy search: no profiled candidate ran; best by "
+                "cost model only: %s", best.describe(),
+            )
+            return SearchResult(best=best, evaluated=measured)
     if not runnable:
         raise RuntimeError(
             "strategy search: no candidate ran successfully"

@@ -15,9 +15,11 @@ respawn cheap with persistent workers; on TPU the equivalent lever is
 amortizing import cost across incarnations.
 
 Protocol (dedicated pipe fds, so worker stdout stays untouched):
-agent -> template: one JSON line per spawn {"env": {...}, "argv": [...]};
-template -> agent: {"event": "spawned", "pid": N} and, from the reap
-loop, {"event": "exit", "pid": N, "code": C}.
+agent -> template: one JSON line per spawn
+{"req": R, "env": {...}, "argv": [...]};
+template -> agent: {"event": "spawned", "pid": N, "req": R} (the
+request id is echoed so concurrent spawns match their own reply) and,
+from the reap loop, {"event": "exit", "pid": N, "code": C}.
 """
 
 import json
@@ -172,7 +174,10 @@ def _template_main(req_fd: int, ev_fd: int):
                 _flush_and_exit(1)
         with lock:
             children[pid] = True
-        emit({"event": "spawned", "pid": pid})
+        emit({
+            "event": "spawned", "pid": pid,
+            "req": spec.get("req", -1),
+        })
     # agent went away: leave children to the reaper of last resort
     os._exit(0)
 
@@ -224,7 +229,12 @@ class WorkerForkServer:
         self._req = None
         self._exits: Dict[int, int] = {}
         self._spawned: List[int] = []
+        self._spawn_results: Dict[int, int] = {}  # req id -> pid
+        self._next_req = 0
         self._lock = threading.Lock()
+        # spawn requests are serialized: the pipe is a shared stream
+        # and matching replies by count races concurrent callers
+        self._spawn_lock = threading.Lock()
         self._reader: Optional[threading.Thread] = None
 
     def _ensure_template(self):
@@ -254,6 +264,9 @@ class WorkerForkServer:
                 with self._lock:
                     if msg["event"] == "spawned":
                         self._spawned.append(msg["pid"])
+                        self._spawn_results[msg.get("req", -1)] = (
+                            msg["pid"]
+                        )
                     elif msg["event"] == "exit":
                         self._exits[msg["pid"]] = msg["code"]
 
@@ -265,16 +278,24 @@ class WorkerForkServer:
         timeout: float = 30.0,
     ) -> ForkedWorkerHandle:
         """Fork the template into a worker running ``argv`` (argv[0]
-        is the script path — the interpreter is already running)."""
-        self._ensure_template()
-        before = len(self._spawned)
-        self._req.write(json.dumps({"env": env, "argv": argv}) + "\n")
-        self._req.flush()
+        is the script path — the interpreter is already running).
+        Requests carry an id echoed back in the spawned event, so
+        concurrent callers each get their own pid."""
+        with self._spawn_lock:
+            self._ensure_template()
+            req_id = self._next_req
+            self._next_req += 1
+            self._req.write(
+                json.dumps({"req": req_id, "env": env, "argv": argv})
+                + "\n"
+            )
+            self._req.flush()
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._lock:
-                if len(self._spawned) > before:
-                    return ForkedWorkerHandle(self._spawned[-1], self)
+                pid = self._spawn_results.pop(req_id, None)
+            if pid is not None:
+                return ForkedWorkerHandle(pid, self)
             time.sleep(0.01)
         raise RuntimeError("fork server did not spawn a worker in time")
 

@@ -187,10 +187,15 @@ class KvVariable:
         (an all-equal-frequency table, e.g. epoch one, evicts
         nothing).  Only the frequency column is exported for the
         threshold computation."""
-        n = len(self)
-        if n <= max_rows:
+        if len(self) <= max_rows:
             return 0
         freq = self.export_freq()
+        # size the threshold math from the exported snapshot, not the
+        # pre-export row count — a concurrent jitted gather can grow
+        # or shrink the table between the two calls
+        n = len(freq)
+        if n <= max_rows:
+            return 0
         order = np.sort(freq)
         cutoff = int(order[n - max_rows - 1]) + 1
         # rows surviving this cutoff; back off while it would wipe

@@ -74,7 +74,12 @@ def q_adamw(
 ) -> optax.GradientTransformation:
     """AdamW with int8 (fused Pallas step) or int4 (packed nibbles,
     8x less moment HBM; reference: 4-bit family in
-    atorch/optimizers/low_bit/) moment storage."""
+    atorch/optimizers/low_bit/) moment storage.
+
+    ``learning_rate`` may be an optax schedule (callable of the
+    0-based step count, matching ``optax.scale_by_schedule``) — a
+    user's warmup/cosine schedule survives the strategy search's
+    optimizer swap."""
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
     if bits == 4:
@@ -107,6 +112,17 @@ def q_adamw(
         bc1 = 1 - b1**count.astype(jnp.float32)
         bc2 = 1 - b2**count.astype(jnp.float32)
         bias_corr = jnp.stack([bc1, bc2]).reshape(1, 2)
+        if callable(learning_rate):
+            # schedule: the kernel runs at unit lr and the (traced)
+            # scalar scales the whole update — exact, because
+            # upd = -lr * (adam_term + wd * p) is linear in lr
+            lr_t = jnp.asarray(
+                learning_rate(state.count), jnp.float32
+            )
+            kernel_lr = 1.0
+        else:
+            lr_t = None
+            kernel_lr = learning_rate
 
         def to_tiles(x):
             return to_block_tiles(x, block_size)
@@ -119,10 +135,12 @@ def q_adamw(
                 to_tiles(g), to_tiles(p),
                 qmu.values, qmu.scales, qnu.values, qnu.scales,
                 bias_corr,
-                b1=b1, b2=b2, eps=eps, lr=learning_rate,
+                b1=b1, b2=b2, eps=eps, lr=kernel_lr,
                 wd=weight_decay,
             )
             upd = upd_t.reshape(-1)[: p.size].reshape(p.shape)
+            if lr_t is not None:
+                upd = lr_t * upd
             return (
                 upd.astype(p.dtype),
                 QMoment(values=qm, scales=ms),
@@ -202,12 +220,16 @@ def _q_adamw_4bit(
         count = state.count + 1
         bc1 = 1 - b1**count.astype(jnp.float32)
         bc2 = 1 - b2**count.astype(jnp.float32)
+        lr_t = (
+            jnp.asarray(learning_rate(state.count), jnp.float32)
+            if callable(learning_rate) else learning_rate
+        )
 
         def leaf_update(g, qmu, qnu, p):
             g = g.astype(jnp.float32)
             mu = b1 * dq4(qmu, g.shape) + (1 - b1) * g
             nu = b2 * dq4u(qnu, g.shape) + (1 - b2) * g * g
-            upd = -learning_rate * (
+            upd = -lr_t * (
                 (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
                 + weight_decay * p.astype(jnp.float32)
             )

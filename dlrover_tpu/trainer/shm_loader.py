@@ -333,7 +333,12 @@ class ShmDataLoader:
         # (device_batch, worker, slot) ring: recycle a slot two
         # batches after its device_put (transfer has landed by then)
         hold: List[Tuple[Any, int, int]] = []
+        # results arrive in worker-completion order; deliver in
+        # batch_id order (deterministic run-to-run, like the torch
+        # multiprocessing loader's task-index reordering)
+        pending: Dict[int, Tuple[int, int, Any]] = {}
         next_id = 0
+        expect_id = 0
         try:
             while True:
                 while inflight < max_inflight and not done:
@@ -344,15 +349,14 @@ class ShmDataLoader:
                     self._task_q.put((next_id, idx))
                     next_id += 1
                     inflight += 1
-                if inflight == 0:
+                if inflight == 0 and expect_id not in pending:
                     break
                 t0 = time.perf_counter()
-                while True:
+                while expect_id not in pending:
                     try:
                         batch_id, worker_id, slot, metas = (
                             self._result_q.get(timeout=5.0)
                         )
-                        break
                     except queue.Empty:
                         if not any(p.is_alive() for p in self._procs):
                             # e.g. spawn could not import __main__
@@ -364,12 +368,17 @@ class ShmDataLoader:
                                 "needs picklable fns and an "
                                 "importable __main__)"
                             )
+                        continue
+                    if slot < 0:
+                        raise RuntimeError(
+                            f"shm loader worker {worker_id} failed: "
+                            f"{metas}"
+                        )
+                    pending[batch_id] = (worker_id, slot, metas)
+                    inflight -= 1
                 self._input_wait_s += time.perf_counter() - t0
-                inflight -= 1
-                if slot < 0:
-                    raise RuntimeError(
-                        f"shm loader worker {worker_id} failed: {metas}"
-                    )
+                worker_id, slot, metas = pending.pop(expect_id)
+                expect_id += 1
                 dev = self._place(
                     self._view_batch(worker_id, slot, metas)
                 )
@@ -391,7 +400,16 @@ class ShmDataLoader:
                 if self._on_batch_done is not None:
                     self._on_batch_done(self.batch_size)
         finally:
-            for _, w, s in hold:
+            for dev, w, s in hold:
+                # a consumer that broke out mid-epoch may still have
+                # an async device_put reading the slot; wait before a
+                # worker can overwrite it
+                try:
+                    import jax
+
+                    jax.block_until_ready(dev)
+                except Exception:  # noqa: BLE001
+                    pass
                 self._free_qs[w].put(s)
 
     def stats(self) -> Dict[str, float]:

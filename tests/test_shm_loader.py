@@ -109,3 +109,32 @@ def test_shm_loader_reports_batch_done():
         assert done == [4, 4]
     finally:
         loader.shutdown()
+
+
+def _read_sample_jittered(i: int):
+    # early indices are SLOW: with 2 workers, batch 1 finishes before
+    # batch 0 unless the parent reorders results by batch id
+    import time as _time
+
+    _time.sleep(0.2 if i < 4 else 0.0)
+    return _read_sample(i)
+
+
+def test_shm_loader_delivers_in_order():
+    """Batches arrive in batch-id order regardless of worker
+    completion order (parity with the torch loader's task-index
+    reordering; ADVICE r3)."""
+    N, B = 16, 4
+    loader = ShmDataLoader(
+        read_fn=_read_sample_jittered,
+        batch_size=B,
+        index_iter=range(N),
+        num_workers=2,
+    )
+    try:
+        order = []
+        for batch in loader:
+            order.append(int(batch["y"][0]))
+        assert order == [0, 4, 8, 12], order
+    finally:
+        loader.shutdown()
