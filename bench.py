@@ -1,21 +1,26 @@
 """Benchmark suite run on the real chip at end of round.
 
-Three measurements, one JSON line:
+Headline: **flash-checkpoint stall** (reference claim ~10x less
+training-blocking time than a synchronous save,
+``docs/blogs/flash_checkpoint.md:361-383``) — training stall of a
+flash save (on-device snapshot + async shm/persist in a separate
+agent process, the real deployment shape) vs a synchronous
+device_get + serialize-to-disk of the same state.
+``vs_baseline`` = our speedup / 10.
 
-1. **Flash-checkpoint stall** (headline; reference claim ~10x less
-   training-blocking time than a synchronous save,
-   ``docs/blogs/flash_checkpoint.md:361-383``): training stall of a
-   flash save (on-device snapshot + async shm/persist in a separate
-   agent process — the real deployment shape) vs a synchronous
-   device_get + serialize-to-disk of the same ~1.5 GB GPT-2-small
-   state.  ``vs_baseline`` = our speedup / 10.
-2. **Train-step MFU** (detail): GPT-2-small, bf16, flash attention,
-   seq 1024 — tokens/s and model FLOPs utilization on this chip.
-3. **Flash vs XLA attention** (detail): fwd+bwd wall time ratio.
+Detail sections: GPT-2-small/XL + Llama-1.1B train-step MFU, flash
+vs XLA attention (incl. GQA shapes), bounded auto-config search,
+sparse KvVariable path, shm input pipeline, and — on the CPU
+backend, concurrently — elastic recovery and goodput under churn.
 
-Prints ONE JSON line:
+Emission contract (VERDICT r3 #1): after EVERY section the bench
+prints the full cumulative JSON line
     {"metric": ..., "value": N, "unit": "x", "vs_baseline": N,
-     "detail": {...}}
+     "detail": {..., "partial": true}}
+so a driver kill at any point still finds the newest metrics in the
+last line of stdout.  The final line is identical minus "partial".
+Sections run headline-first under per-section budgets inside a
+~14-minute total deadline (override: BENCH_DEADLINE_S).
 """
 
 import json
@@ -62,6 +67,14 @@ def _best_of(n: int, sample) -> float:
         dt = sample()
         best = dt if best is None else min(best, dt)
     return best
+
+
+def _round_finite(x, digits: int = 4):
+    """round(x) when x is a finite number, else None (JSON-safe)."""
+    import math
+
+    return round(x, digits) if x is not None and math.isfinite(x) \
+        else None
 
 
 def _flops_per_token(cfg, n_params: int, seq: int) -> float:
@@ -462,11 +475,14 @@ def bench_sparse_kv(jax, results: dict):
 
 
 def bench_auto_config(jax, results: dict):
-    """Strategy search ON THE CHIP: the generator + HBM pruning + BO
-    pick a recipe for GPT-2-XL (1.56B) under the 16 GB budget with
-    real profiled steps, compared against the hand-tuned recipe of
-    ``bench_xl_train_step`` (reference pitch: the machine finds the
-    config — atorch/auto/engine/acceleration_engine.py:13)."""
+    """BOUNDED strategy search ON THE CHIP (VERDICT r3 #4: the
+    unbounded profile-everything search is what blew the round-3
+    deadline): the static cost-model tier ranks every HBM-surviving
+    candidate from compiles alone, and only the top-1 pays for
+    on-chip profiled steps — compared against the hand-tuned
+    GPT-2-small recipe measured by ``bench_train_step`` (reference
+    pitch: the machine finds the config —
+    atorch/auto/engine/acceleration_engine.py:13)."""
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -481,11 +497,10 @@ def bench_auto_config(jax, results: dict):
 
     if os.getenv("BENCH_SMOKE"):
         return
-    batch, seq = 4, 1024
-    cfg = GPTConfig(
-        num_layers=48, num_heads=25, hidden_dim=1600,
-        max_seq_len=seq,
-    )
+    # same model/shape as bench_train_step so its measured flash
+    # step is the hand-recipe control
+    batch, seq = 16, 1024
+    cfg = GPTConfig.gpt2_small(max_seq_len=seq)
     model = GPT(cfg)
     tokens = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32
@@ -508,29 +523,30 @@ def bench_auto_config(jax, results: dict):
     )
     t0 = time.perf_counter()
     result = search_strategy(
-        context, num_devices=1, dry_run_budget=4, grad_accums=(1,),
-        rank_mode="profile",
+        context, num_devices=1, grad_accums=(1,),
+        rank_mode="hybrid", profile_top_k=1, profile_steps=4,
     )
     search_wall = time.perf_counter() - t0
-    hand = results.get("xl_train_step", {}).get("step_time_s")
+    hand = (
+        results.get("train_step", {})
+        .get("flash_attention", {})
+        .get("step_time_s")
+    )
+    best_t = result.best.step_time_s or result.best.est_step_time_s
     results["auto_config"] = {
-        "model": "gpt2_xl",
+        "model": "gpt2_small",
+        "search": "hybrid: cost-model ranks all, top-1 profiled",
         "searched_recipe": result.best.describe(),
-        "searched_step_time_s": round(result.best.step_time_s, 4),
+        "searched_step_time_s": round(best_t, 4),
         "hand_recipe_step_time_s": hand,
         "searched_vs_hand": (
-            round(result.best.step_time_s / hand, 3)
-            if hand else None
+            round(best_t / hand, 3) if hand else None
         ),
         "search_wall_s": round(search_wall, 1),
         "evaluated": [
             {"recipe": c.describe(),
-             "step_time_s": (
-                 round(c.step_time_s, 4)
-                 if c.step_time_s is not None
-                 and c.step_time_s == c.step_time_s
-                 and c.step_time_s != float("inf") else None
-             )}
+             "est_step_time_s": _round_finite(c.est_step_time_s),
+             "step_time_s": _round_finite(c.step_time_s)}
             for c in result.evaluated
         ],
     }
@@ -783,11 +799,20 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     from dlrover_tpu.models.gpt import GPT, GPTConfig, count_params
     from dlrover_tpu.trainer.elastic_trainer import TrainState
 
-    # GPT-2 small + adam: ~124M params x3 states ~1.5 GB fp32 pytree
+    # a 2-layer GPT-2-small slice + adam: ~53M params x3 states
+    # ~0.6 GB fp32 pytree.  Sized deliberately: the remote-device
+    # tunnel moves D2H at ~13 MB/s, so the old 1.5 GB state made this
+    # one section ~7 minutes of pure transfer and starved the rest of
+    # the bench (VERDICT r3 weak #1); the stall-vs-sync RATIO — the
+    # reference's headline (flash_checkpoint.md:361-383) — is
+    # size-independent, and state_mb is reported alongside
     cfg = (
         GPTConfig.tiny()
         if os.getenv("BENCH_SMOKE")
-        else GPTConfig.gpt2_small(max_seq_len=512)
+        else GPTConfig(
+            num_layers=2, num_heads=12, hidden_dim=768,
+            max_seq_len=512,
+        )
     )
     model = GPT(cfg)
     params = model.init_params(
@@ -854,7 +879,7 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
         # warm up (jit of the on-device copy, shm allocation, saver
         # handshake) — pays one full snapshot
         assert engine.save_to_storage(1, state_dict)
-        assert engine.wait_async(timeout=1800.0)
+        assert engine.wait_async(timeout=240.0)
         tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
 
         def committed_step():
@@ -869,10 +894,10 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
         ok = engine.save_to_storage(2, state_dict)
         stalls.append(time.perf_counter() - t0)
         assert ok, "flash save of step 2 was skipped"
-        assert engine.wait_async(timeout=1800.0)
+        assert engine.wait_async(timeout=240.0)
         assert engine._last_async_error is None
         snapshot_e2e = time.perf_counter() - t0
-        deadline = time.time() + 1800
+        deadline = time.time() + 240
         while time.time() < deadline and committed_step() < 2:
             time.sleep(0.5)
         persist_e2e = time.perf_counter() - t0
@@ -891,6 +916,7 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     f_sync_post, _ = sync_save()
     f_sync = (f_sync_pre + f_sync_post) / 2
     d2h_mbps = state_bytes / 2**20 / max(t_d2h, 1e-9)
+    results["_speedup"] = f_sync / max(f_flash, 1e-9)
     results["flash_ckpt"] = {
         "sync_save_s": round(f_sync, 3),
         "sync_save_pre_post_s": [
@@ -1074,7 +1100,7 @@ def bench_goodput_churn(results: dict, workdir: str):
     cross-check."""
     import signal
 
-    duration = float(os.getenv("BENCH_GOODPUT_S", "360"))
+    duration = float(os.getenv("BENCH_GOODPUT_S", "300"))
     kill_every = float(os.getenv("BENCH_GOODPUT_KILL_EVERY", "60"))
     churn_dir = os.path.join(workdir, "goodput")
     os.makedirs(churn_dir, exist_ok=True)
@@ -1259,20 +1285,69 @@ def bench_elastic_recovery(results: dict, workdir: str):
     }
 
 
-def _emit(results: dict, speedup: float):
-    print(
-        json.dumps(
-            {
-                "metric": "flash_ckpt_stall_speedup_vs_sync_save",
-                "value": round(speedup, 2),
-                "unit": "x",
-                # reference claims ~10x vs sync NVMe save
-                "vs_baseline": round(speedup / 10.0, 3),
-                "detail": results,
-            }
-        ),
-        flush=True,
+_EMIT_LOCK = None  # created in main() (threading imported there)
+
+
+def _emit(results: dict, partial: bool = False):
+    """One cumulative JSON line, same schema every time.  Called after
+    EVERY section (VERDICT r3 #1): the driver records the LAST JSON
+    line it sees, so a kill at any point still leaves the newest
+    metrics in the tail instead of losing the whole round.
+
+    Concurrency: the CPU-section thread and abandoned section threads
+    insert keys while this runs — snapshot with a bounded retry (each
+    section writes whole keys atomically, so a clean copy is a
+    consistent view) and serialize the print so two emitters cannot
+    interleave one stdout line."""
+    import threading
+
+    global _EMIT_LOCK
+    lock = _EMIT_LOCK or threading.Lock()
+    with lock:
+        snapshot = {}
+        for _ in range(10):
+            try:
+                snapshot = dict(results)
+                break
+            except RuntimeError:  # dict changed size during iteration
+                time.sleep(0.01)
+        speedup = float(snapshot.get("_speedup", 0.0))
+        detail = {k: v for k, v in snapshot.items() if k != "_speedup"}
+        if partial:
+            detail["partial"] = True
+        print(
+            json.dumps(
+                {
+                    "metric": "flash_ckpt_stall_speedup_vs_sync_save",
+                    "value": round(speedup, 2),
+                    "unit": "x",
+                    # reference claims ~10x vs sync NVMe save
+                    "vs_baseline": round(speedup / 10.0, 3),
+                    "detail": detail,
+                }
+            ),
+            flush=True,
+        )
+
+
+def _enable_compile_cache(jax):
+    """Best-effort persistent XLA compile cache: the auto-config
+    section recompiles near-identical HLO per candidate, and warm
+    restarts/replays across rounds reuse it."""
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/dlrover_jax_cache"
     )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", 0
+        )
+    except Exception:  # noqa: BLE001 - unsupported on some backends
+        pass
 
 
 def main() -> int:
@@ -1284,23 +1359,34 @@ def main() -> int:
 
     import jax
 
+    global _EMIT_LOCK
+    _EMIT_LOCK = threading.Lock()
+    _enable_compile_cache(jax)
     results = {"platform": jax.devices()[0].platform}
+    smoke = bool(os.getenv("BENCH_SMOKE"))
 
-    # the remote-device tunnel can HANG silently mid-transfer (not
-    # just error); a hung section must not eat the whole run — after
-    # the deadline, emit whatever was measured and exit
-    deadline_s = float(os.getenv("BENCH_DEADLINE_S", "5400"))
+    # total budget UNDER the driver kill window (r3 died at ~19 min
+    # with zero emissions; r2 survived at ~16).  Sections get
+    # individual budgets; whatever does not fit is skipped with a
+    # note — a skipped detail section beats a dead headline one.
+    deadline_s = float(os.getenv("BENCH_DEADLINE_S", "840"))
+    t_start = time.time()
+
+    def remaining() -> float:
+        return deadline_s - (time.time() - t_start)
+
     done_evt = threading.Event()
 
     def watchdog():
-        if done_evt.wait(deadline_s):
+        # last resort: a hung tunnel transfer inside a section thread
+        # must not keep the process alive past the driver's patience
+        if done_evt.wait(deadline_s + 60):
             return
         results["watchdog"] = (
-            f"bench exceeded {deadline_s:.0f}s; emitting partial "
-            "results (a tunnel transfer likely hung)"
+            f"bench exceeded {deadline_s + 60:.0f}s; emitting "
+            "partial results (a tunnel transfer likely hung)"
         )
-        speedup = results.pop("_speedup", 0.0)
-        _emit(results, speedup)
+        _emit(results, partial=True)
         # exit 0 deliberately: an rc-gating harness that discards
         # output on failure would lose the partial results; the
         # "watchdog" key marks the run as abnormal for any consumer
@@ -1308,99 +1394,100 @@ def main() -> int:
         os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()
-    # the tunnel backend occasionally drops a connection mid-compile;
-    # one retry distinguishes transient infra from real failures
-    for attempt in (1, 2):
+
+    # CPU-only sections (subprocesses on the virtual CPU backend)
+    # start at t=0 in the background — they share no chip time with
+    # the device sections, only host cores
+    def cpu_sections():
         try:
-            bench_train_step(jax, results)
-            results.pop("train_step_error", None)
-            break
+            bench_elastic_recovery(results, workdir)
         except Exception as e:  # noqa: BLE001
-            results["train_step_error"] = f"{type(e).__name__}: {e}"
-            time.sleep(5)
-    for attempt in (1, 2):
-        try:
-            bench_attention_kernel(jax, results)
-            results.pop("attention_kernel_error", None)
-            break
-        except Exception as e:  # noqa: BLE001
-            results["attention_kernel_error"] = (
+            results["elastic_recovery_error"] = (
                 f"{type(e).__name__}: {e}"
             )
-            time.sleep(5)
-    for attempt in (1, 2):
-        try:
-            bench_xl_train_step(jax, results)
-            results.pop("xl_train_step_error", None)
-            break
-        except Exception as e:  # noqa: BLE001
-            results["xl_train_step_error"] = f"{type(e).__name__}: {e}"
-            time.sleep(10)
-    for attempt in (1, 2):
-        try:
-            bench_auto_config(jax, results)
-            results.pop("auto_config_error", None)
-            break
-        except Exception as e:  # noqa: BLE001
-            results["auto_config_error"] = f"{type(e).__name__}: {e}"
-            time.sleep(10)
-    for attempt in (1, 2):
-        try:
-            bench_llama_train_step(jax, results)
-            results.pop("llama_train_step_error", None)
-            break
-        except Exception as e:  # noqa: BLE001
-            results["llama_train_step_error"] = (
-                f"{type(e).__name__}: {e}"
+        if not smoke:
+            try:
+                bench_goodput_churn(results, workdir)
+            except Exception as e:  # noqa: BLE001
+                results["goodput_error"] = f"{type(e).__name__}: {e}"
+
+    cpu_thread = threading.Thread(target=cpu_sections, daemon=True)
+    cpu_thread.start()
+
+    def run_section(name: str, fn, budget_s: float) -> None:
+        """One section in a worker thread: a hung device call burns
+        its budget, not the run.  One retry inside the same budget
+        (the tunnel drops connections mid-compile now and then)."""
+        rem = remaining()
+        if rem < min(45.0, budget_s):
+            results[name + "_note"] = (
+                f"skipped: {rem:.0f}s left < section budget"
             )
-            time.sleep(10)
-    for attempt in (1, 2):
-        try:
-            bench_gqa_attention_kernel(jax, results)
-            results.pop("gqa_attention_kernel_error", None)
-            break
-        except Exception as e:  # noqa: BLE001
-            results["gqa_attention_kernel_error"] = (
-                f"{type(e).__name__}: {e}"
+            _emit(results, partial=True)
+            return
+
+        def body():
+            for attempt in (1, 2):
+                try:
+                    fn()
+                    results.pop(name + "_error", None)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    results[name + "_error"] = (
+                        f"{type(e).__name__}: {e}"
+                    )
+                    time.sleep(3)
+
+        t = threading.Thread(target=body, daemon=True)
+        t0 = time.time()
+        t.start()
+        t.join(min(budget_s, rem))
+        if t.is_alive():
+            # slow-but-alive vs hung: grant a short grace before
+            # abandoning — an abandoned-but-running section keeps
+            # issuing device work and contends with later sections'
+            # timings, so flag that contention on everything after
+            t.join(min(60.0, max(0.0, remaining() / 4)))
+        if t.is_alive():
+            results[name + "_note"] = (
+                f"timed out after {time.time() - t0:.0f}s "
+                f"(budget {budget_s:.0f}s); section thread abandoned "
+                "— later device timings may include its contention"
             )
-            time.sleep(5)
-    for attempt in (1, 2):
-        try:
-            bench_input_pipeline(jax, results)
-            results.pop("input_pipeline_error", None)
-            break
-        except Exception as e:  # noqa: BLE001
-            results["input_pipeline_error"] = (
-                f"{type(e).__name__}: {e}"
-            )
-            time.sleep(5)
-    for attempt in (1, 2):
-        try:
-            bench_sparse_kv(jax, results)
-            results.pop("sparse_kv_error", None)
-            break
-        except Exception as e:  # noqa: BLE001
-            results["sparse_kv_error"] = f"{type(e).__name__}: {e}"
-            time.sleep(5)
-    speedup = 0.0
-    try:
-        speedup = bench_flash_ckpt(jax, results, workdir)
-        results["_speedup"] = speedup
-    except Exception as e:  # noqa: BLE001
-        results["flash_ckpt_error"] = f"{type(e).__name__}: {e}"
-    try:
-        bench_elastic_recovery(results, workdir)
-    except Exception as e:  # noqa: BLE001
-        results["elastic_recovery_error"] = f"{type(e).__name__}: {e}"
-    if not os.getenv("BENCH_SMOKE"):
-        try:
-            bench_goodput_churn(results, workdir)
-        except Exception as e:  # noqa: BLE001
-            results["goodput_error"] = f"{type(e).__name__}: {e}"
+        _emit(results, partial=True)
+
+    # headline-first: by the time anything is killed, the required
+    # metrics (train MFU, llama MFU, flash-ckpt stall+snapshot_e2e,
+    # bounded auto-config) are already on stdout; goodput arrives
+    # from the CPU thread, re-emitted at the join below
+    sections = [
+        ("train_step", lambda: bench_train_step(jax, results), 180),
+        ("llama_train_step",
+         lambda: bench_llama_train_step(jax, results), 270),
+        ("flash_ckpt",
+         lambda: bench_flash_ckpt(jax, results, workdir), 280),
+        ("auto_config", lambda: bench_auto_config(jax, results), 210),
+        ("xl_train_step",
+         lambda: bench_xl_train_step(jax, results), 180),
+        ("attention_kernel",
+         lambda: bench_attention_kernel(jax, results), 120),
+        ("gqa_attention_kernel",
+         lambda: bench_gqa_attention_kernel(jax, results), 120),
+        ("sparse_kv", lambda: bench_sparse_kv(jax, results), 90),
+        ("input_pipeline",
+         lambda: bench_input_pipeline(jax, results), 90),
+    ]
+    for name, fn, budget in sections:
+        run_section(name, fn, budget)
+
+    cpu_thread.join(max(10.0, remaining()))
+    if cpu_thread.is_alive():
+        results["cpu_sections_note"] = (
+            "goodput/recovery still running at deadline"
+        )
     shutil.rmtree(workdir, ignore_errors=True)
     done_evt.set()
-    results.pop("_speedup", None)
-    _emit(results, speedup)
+    _emit(results)
     return 0
 
 
