@@ -31,6 +31,7 @@ import statistics
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets)
@@ -67,6 +68,31 @@ def _best_of(n: int, sample) -> float:
         dt = sample()
         best = dt if best is None else min(best, dt)
     return best
+
+
+# supervision trees launched by CPU sections (goodput churn, elastic
+# recovery): registered so the deadline/watchdog exit paths can kill
+# them instead of orphaning restart-looping trainers on the machine
+_LIVE_PROCS = []
+
+
+def _register_proc(proc):
+    _LIVE_PROCS.append(proc)
+    return proc
+
+
+def _kill_live_procs():
+    import signal
+
+    for proc in list(_LIVE_PROCS):
+        try:
+            if proc.poll() is None:
+                os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        except Exception:  # noqa: BLE001
+            pass
+    _LIVE_PROCS.clear()
 
 
 def _round_finite(x, digits: int = 4):
@@ -377,16 +403,25 @@ def _read_tokens(i: int):
 
 
 def bench_sparse_kv(jax, results: dict):
-    """Sparse path on the chip: KvVariable host-table gather under
-    jit (io_callback round trip quantified) + GroupAdam sparse update
-    throughput (reference: tfplus kv_variable_ops.cc:37 +
-    group_adam.py)."""
-    import jax.numpy as jnp
+    """Sparse path END-TO-END on the chip via the split step
+    (VERDICT r3 #3: host callbacks hang through the tunneled device,
+    so the production path is host gather -> jitted dense step ->
+    host group-Adam update, double-buffered so the table work
+    overlaps device compute — the reference's CPU-parameter-server
+    shape, tfplus kv_variable_ops.cc:37 + training/group_adam.py:28).
+    Reports raw host table rates AND full DeepFM steps/s with device
+    compute included, pipelined vs strict."""
     import numpy as np
+    import optax
 
+    from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
     from dlrover_tpu.ops.kv_variable import (
         GroupAdamOptimizer,
         KvVariable,
+    )
+    from dlrover_tpu.trainer.sparse_pipeline import (
+        SparseTrainPipeline,
+        make_deepfm_device_step,
     )
 
     if os.getenv("BENCH_SMOKE"):
@@ -407,8 +442,7 @@ def bench_sparse_kv(jax, results: dict):
     host_dt = (time.perf_counter() - t0) / len(key_sets)
 
     # (b) host gather + host GroupAdam update (the sparse train step
-    # minus device compute) — the sparse tables live host-side by
-    # design, like the reference's CPU parameter servers
+    # minus device compute)
     grads = np.ones((B, dim), np.float32)
     t0 = time.perf_counter()
     for k in key_sets:
@@ -416,61 +450,80 @@ def bench_sparse_kv(jax, results: dict):
         opt.apply_gradients(k, grads)
     step_dt = (time.perf_counter() - t0) / len(key_sets)
 
-    # (c) the gather INSIDE a jitted device program (io_callback).
-    # Host callbacks HANG through a tunneled remote device (the
-    # callback would have to run on the far side), so this leg runs
-    # in a subprocess with a hard timeout and reports honestly when
-    # the platform cannot do it.
-    probe = (
-        "import time, numpy as np, jax, jax.numpy as jnp\n"
-        "from dlrover_tpu.ops.kv_variable import KvVariable\n"
-        f"dim, B = {dim}, {B}\n"
-        "t = KvVariable(dim=dim, initial_capacity=1 << 16)\n"
-        "ks = [np.random.default_rng(i).integers(0, 200000, B)"
-        ".astype(np.int64) for i in range(4)]\n"
-        "f = jax.jit(lambda k: (lambda e: (e * e).sum())"
-        "(t.jax_gather(k)))\n"
-        "float(f(jnp.asarray(ks[0])))\n"
-        "t0 = time.perf_counter()\n"
-        "for k in ks:\n"
-        "    out = f(jnp.asarray(k))\n"
-        "float(out)\n"
-        "print('JIT_DT', (time.perf_counter() - t0) / len(ks))\n"
-    )
-    jit_dt = None
-    jit_note = ""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", probe], cwd=os.getcwd(),
-            capture_output=True, text=True, timeout=120,
-        )
-        for line in r.stdout.splitlines():
-            if line.startswith("JIT_DT"):
-                jit_dt = float(line.split()[1])
-        if jit_dt is None:
-            jit_note = f"failed: {r.stderr[-200:]}"
-    except subprocess.TimeoutExpired:
-        jit_note = (
-            "unavailable: host callbacks (io_callback) hang through "
-            "the tunneled remote device; use the host-side gather + "
-            "device_put path on this deployment"
-        )
+    # (c) the full hybrid train step: criteo-class DeepFM, 26 sparse
+    # fields, FM + deep tower on the chip, tables on the host
+    cfg = DeepFMConfig(embedding_dim=16)
+    batch, steps = 512, 12
+    data_rng = np.random.default_rng(1)
 
+    def make_batches(n):
+        out = []
+        for _ in range(n):
+            sparse = data_rng.integers(
+                0, 200_000, (batch, cfg.num_sparse_fields)
+            ).astype(np.int64)
+            dense = data_rng.normal(
+                size=(batch, cfg.num_dense_features)
+            ).astype(np.float32)
+            labels = (sparse[:, 0] % 2).astype(np.float32)
+            out.append((sparse, dense, labels))
+        return out
+
+    # ONE jitted step shared by both tiers (model.apply is a pure
+    # function of the config; the tables are host objects handed to
+    # the pipeline, so the second tier reuses the compiled HLO)
+    optimizer = optax.adam(1e-2)
+    shared_model = DeepFM(cfg)
+    dstep = make_deepfm_device_step(shared_model, optimizer)
+
+    def run_tier(pipeline: bool):
+        model = DeepFM(cfg)
+        params = model.init_dense_params()
+        state = (params, optimizer.init(params))
+        pipe = SparseTrainPipeline(
+            model.table, model.sparse_optimizer, dstep,
+            pipeline=pipeline,
+        )
+        state = pipe.run(state, make_batches(2))  # compile + warm
+        pipe.stats.update(
+            steps=0, gather_s=0.0, fetch_s=0.0, update_s=0.0,
+            dispatch_s=0.0, wall_s=0.0,
+        )
+        last = {}
+        state = pipe.run(
+            state, make_batches(steps),
+            on_aux=lambda a: last.update(a),
+        )
+        loss = float(last["loss"])  # the honest end-of-run sync
+        rep = pipe.overlap_report()
+        rep["loss"] = round(loss, 4)
+        rep["steps_per_s"] = round(steps / rep["wall_s"], 2)
+        for k in ("gather_s", "fetch_s", "update_s", "dispatch_s",
+                  "wall_s"):
+            rep[k] = round(rep[k], 4)
+        return rep
+
+    pipelined = run_tier(True)
+    strict = run_tier(False)
     results["sparse_kv"] = {
         "dim": dim,
         "batch_keys": B,
         "table_rows": len(table),
         "host_gather_Mlookups_per_s": round(B / host_dt / 1e6, 3),
-        "sparse_step_per_s": round(1.0 / step_dt, 2),
-        "sparse_Mlookups_per_s": round(B / step_dt / 1e6, 3),
+        "host_step_per_s": round(1.0 / step_dt, 2),
+        "host_Mlookups_per_s": round(B / step_dt / 1e6, 3),
         "bytes_per_gather_mb": round(B * dim * 4 / 2**20, 2),
-        "jit_gather_Mlookups_per_s": (
-            round(B / jit_dt / 1e6, 3) if jit_dt else None
-        ),
-        "io_callback_overhead_ms": (
-            round((jit_dt - host_dt) * 1e3, 2) if jit_dt else None
-        ),
-        "jit_gather_note": jit_note,
+        "deepfm_e2e": {
+            "model": "deepfm 26 sparse fields, dim 16",
+            "batch": batch,
+            "split_step": "host gather -> device FM+MLP -> host "
+                          "group-adam (staleness-1 double buffer)",
+            "pipelined": pipelined,
+            "strict": strict,
+            "pipeline_speedup": round(
+                strict["wall_s"] / max(pipelined["wall_s"], 1e-9), 3
+            ),
+        },
     }
 
 
@@ -840,7 +893,11 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     # flash saves — and averaged: the device link's bandwidth drifts
     # minute to minute, and a single sample makes the
     # snapshot-vs-sync ratio a coin flip.
-    sync_dir = os.path.join(workdir, "sync")
+    # fresh per-attempt dirs: run_section retries this function, and
+    # a stale tracker from a failed attempt would make the
+    # persist-commit wait a no-op (falsifying persist_e2e)
+    attempt_dir = tempfile.mkdtemp(prefix="attempt_", dir=workdir)
+    sync_dir = os.path.join(attempt_dir, "sync")
     os.makedirs(sync_dir, exist_ok=True)
 
     def sync_save():
@@ -868,7 +925,7 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     line = agent.stdout.readline()
     assert "agent-ready" in line, f"agent failed to start: {line!r}"
 
-    ckpt_dir = os.path.join(workdir, "flash")
+    ckpt_dir = os.path.join(attempt_dir, "flash")
     engine = CheckpointEngine(
         ckpt_dir, replicated=True, local_rank=0, global_rank=0,
         world_size=1,
@@ -1127,7 +1184,7 @@ def bench_goodput_churn(results: dict, workdir: str):
             env=env, cwd=os.getcwd(), stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL, start_new_session=True,
         )
-        return proc, progress
+        return _register_proc(proc), progress
 
     def read_progress(path):
         out = []
@@ -1165,6 +1222,8 @@ def bench_goodput_churn(results: dict, workdir: str):
         except subprocess.TimeoutExpired:
             os.killpg(proc.pid, signal.SIGKILL)
             proc.wait()
+        if proc in _LIVE_PROCS:
+            _LIVE_PROCS.remove(proc)
 
 
     # -- calibration: churn-free step rate, measured from the first
@@ -1285,7 +1344,7 @@ def bench_elastic_recovery(results: dict, workdir: str):
     }
 
 
-_EMIT_LOCK = None  # created in main() (threading imported there)
+_EMIT_LOCK = threading.Lock()
 
 
 def _emit(results: dict, partial: bool = False):
@@ -1299,11 +1358,7 @@ def _emit(results: dict, partial: bool = False):
     section writes whole keys atomically, so a clean copy is a
     consistent view) and serialize the print so two emitters cannot
     interleave one stdout line."""
-    import threading
-
-    global _EMIT_LOCK
-    lock = _EMIT_LOCK or threading.Lock()
-    with lock:
+    with _EMIT_LOCK:
         snapshot = {}
         for _ in range(10):
             try:
@@ -1355,12 +1410,8 @@ def main() -> int:
     os.environ.setdefault(
         "DLROVER_SHARED_DIR", os.path.join(workdir, "sockets")
     )
-    import threading
-
     import jax
 
-    global _EMIT_LOCK
-    _EMIT_LOCK = threading.Lock()
     _enable_compile_cache(jax)
     results = {"platform": jax.devices()[0].platform}
     smoke = bool(os.getenv("BENCH_SMOKE"))
@@ -1386,6 +1437,7 @@ def main() -> int:
             f"bench exceeded {deadline_s + 60:.0f}s; emitting "
             "partial results (a tunnel transfer likely hung)"
         )
+        _kill_live_procs()
         _emit(results, partial=True)
         # exit 0 deliberately: an rc-gating harness that discards
         # output on failure would lose the partial results; the
@@ -1395,9 +1447,12 @@ def main() -> int:
 
     threading.Thread(target=watchdog, daemon=True).start()
 
-    # CPU-only sections (subprocesses on the virtual CPU backend)
-    # start at t=0 in the background — they share no chip time with
-    # the device sections, only host cores
+    # CPU-only sections (subprocesses on the virtual CPU backend) run
+    # in the background CONCURRENTLY with the device sections: they
+    # share no chip time, but they do contend for host cores, which
+    # is the bench's documented dispatch-noise source — so they start
+    # only after the small-MFU headline section has finished clean,
+    # and the overlap is flagged in the emitted detail
     def cpu_sections():
         try:
             bench_elastic_recovery(results, workdir)
@@ -1412,7 +1467,6 @@ def main() -> int:
                 results["goodput_error"] = f"{type(e).__name__}: {e}"
 
     cpu_thread = threading.Thread(target=cpu_sections, daemon=True)
-    cpu_thread.start()
 
     def run_section(name: str, fn, budget_s: float) -> None:
         """One section in a worker thread: a hung device call burns
@@ -1479,12 +1533,22 @@ def main() -> int:
     ]
     for name, fn, budget in sections:
         run_section(name, fn, budget)
+        if not cpu_thread.is_alive() and cpu_thread.ident is None:
+            # first section done: launch the CPU-side benches; device
+            # timings from here on share host cores with them
+            results["cpu_concurrency_note"] = (
+                "goodput/recovery ran on host cores concurrently "
+                "with the device sections after train_step"
+            )
+            cpu_thread.start()
 
     cpu_thread.join(max(10.0, remaining()))
     if cpu_thread.is_alive():
         results["cpu_sections_note"] = (
-            "goodput/recovery still running at deadline"
+            "goodput/recovery still running at deadline; their "
+            "supervision trees were killed"
         )
+        _kill_live_procs()
     shutil.rmtree(workdir, ignore_errors=True)
     done_evt.set()
     _emit(results)

@@ -1,0 +1,107 @@
+"""Split-step sparse pipeline: host gather -> jitted device step ->
+host group-optimizer update, double-buffered (reference shape: CPU
+parameter servers feeding accelerators — tfplus
+kv_variable_ops.cc:37 + training/group_adam.py:28; VERDICT r3 #3)."""
+
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+from dlrover_tpu.trainer.sparse_pipeline import (
+    SparseTrainPipeline,
+    make_deepfm_device_step,
+)
+
+
+def _cfg():
+    return DeepFMConfig(
+        num_sparse_fields=4, num_dense_features=3,
+        embedding_dim=8, hidden_dims=(32,),
+    )
+
+
+def _batches(cfg, n, batch=64, vocab=300, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        sparse = rng.integers(
+            0, vocab, (batch, cfg.num_sparse_fields)
+        ).astype(np.int64)
+        dense = rng.normal(
+            size=(batch, cfg.num_dense_features)
+        ).astype(np.float32)
+        labels = (sparse[:, 0] % 2).astype(np.float32)
+        out.append((sparse, dense, labels))
+    return out
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_sparse_pipeline_trains(pipeline):
+    """Both tiers converge on the learnable parity rule; staleness-1
+    double buffering must not break training."""
+    import jax.numpy as jnp
+
+    cfg = _cfg()
+    model = DeepFM(cfg)
+    optimizer = optax.adam(1e-2)
+    params = model.init_dense_params()
+    state = (params, optimizer.init(params))
+    step = make_deepfm_device_step(model, optimizer)
+    pipe = SparseTrainPipeline(
+        model.table, model.sparse_optimizer, step, pipeline=pipeline
+    )
+    losses = []
+    # 5 distinct batches cycled: keys recur so the embeddings can
+    # actually learn the parity rule
+    data = _batches(cfg, 5) * 12
+    state = pipe.run(
+        state, data, on_aux=lambda a: losses.append(a["loss"])
+    )
+    losses = [float(x) for x in losses]
+    assert len(losses) == 60
+    assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+    # every batch's sparse update was applied (including the drained
+    # final in-flight one)
+    assert model.sparse_optimizer.step == 60
+    rep = pipe.overlap_report()
+    assert rep["steps"] == 60
+    assert rep["gather_s"] > 0 and rep["update_s"] > 0
+
+
+def test_sparse_pipeline_staleness_is_one():
+    """The pipelined gather for batch k+1 sees updates through k-1
+    but NOT k (the defining PS property); strict mode sees k."""
+    cfg = DeepFMConfig(
+        num_sparse_fields=1, num_dense_features=1,
+        embedding_dim=4, hidden_dims=(4,),
+    )
+    for pipeline, expect_stale in ((False, False), (True, True)):
+        model = DeepFM(cfg)
+        optimizer = optax.adam(1e-2)
+        params = model.init_dense_params()
+        state = (params, optimizer.init(params))
+        step = make_deepfm_device_step(model, optimizer)
+        seen = []
+        orig_gather = model.table.gather
+
+        def gather_spy(keys, *a, _t=model.table, _o=orig_gather, **kw):
+            out = _o(keys, *a, **kw)
+            seen.append(model.sparse_optimizer.step)
+            return out
+
+        model.table.gather = gather_spy
+        pipe = SparseTrainPipeline(
+            model.table, model.sparse_optimizer, step,
+            pipeline=pipeline,
+        )
+        same_key = np.zeros((8, 1), dtype=np.int64)
+        dense = np.zeros((8, 1), dtype=np.float32)
+        labels = np.ones(8, dtype=np.float32)
+        pipe.run(state, [(same_key, dense, labels)] * 4)
+        # seen[i] = optimizer steps completed when gather i ran
+        if expect_stale:
+            assert seen == [0, 0, 1, 2], seen
+        else:
+            assert seen == [0, 1, 2, 3], seen
+        assert model.sparse_optimizer.step == 4
