@@ -74,9 +74,20 @@ def _best_of(n: int, sample) -> float:
 # recovery): registered so the deadline/watchdog exit paths can kill
 # them instead of orphaning restart-looping trainers on the machine
 _LIVE_PROCS = []
+_PROCS_SHUTDOWN = False
 
 
 def _register_proc(proc):
+    if _PROCS_SHUTDOWN:
+        # an exit path already swept the registry; the racing CPU
+        # thread must not leave a fresh orphan behind
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return proc
     _LIVE_PROCS.append(proc)
     return proc
 
@@ -84,6 +95,8 @@ def _register_proc(proc):
 def _kill_live_procs():
     import signal
 
+    global _PROCS_SHUTDOWN
+    _PROCS_SHUTDOWN = True
     for proc in list(_LIVE_PROCS):
         try:
             if proc.poll() is None:
@@ -1088,11 +1101,12 @@ from dlrover_tpu.trainer.elastic_trainer import (
 )
 
 ckpt_dir, progress_path = sys.argv[1:3]
-CKPT_EVERY = 5
+CKPT_EVERY = 2
 _t0 = time.time()
 _prog = open(progress_path, "a")
 def _mark(name):
-    _prog.write(f"# {name} {time.time() - _t0:.2f}\n")
+    now = time.time()
+    _prog.write(f"# {name} {now:.4f} {now - _t0:.2f}\n")
     _prog.flush()
 _mark("boot")
 
@@ -1200,6 +1214,23 @@ def bench_goodput_churn(results: dict, workdir: str):
                         continue
         return out
 
+    def read_marks(path):
+        """Worker lifecycle marks ``# name abs_ts rel_ts`` in file
+        order — one boot/restore/first_step triple per incarnation."""
+        out = []
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if not line.startswith("# "):
+                        continue
+                    parts = line.split()
+                    if len(parts) >= 3:
+                        try:
+                            out.append((parts[1], float(parts[2])))
+                        except ValueError:
+                            continue
+        return out
+
     def current_trainer_pid(path):
         pid = None
         if os.path.exists(path):
@@ -1247,7 +1278,7 @@ def bench_goodput_churn(results: dict, workdir: str):
     # -- churn run
     proc, progress = launch("churn")
     t_start = time.time()
-    kills = 0
+    kill_times = []
     next_kill = t_start + kill_every
     while time.time() - t_start < duration:
         time.sleep(1.0)
@@ -1256,12 +1287,13 @@ def bench_goodput_churn(results: dict, workdir: str):
             if pid is not None:
                 try:
                     os.kill(pid, signal.SIGKILL)
-                    kills += 1
+                    kill_times.append(time.time())
                 except ProcessLookupError:
                     pass
             next_kill += kill_every
     wall = time.time() - t_start
     stop(proc)
+    kills = len(kill_times)
 
     entries = read_progress(progress)
     distinct = len({step for _, step in entries})
@@ -1287,6 +1319,60 @@ def bench_goodput_churn(results: dict, workdir: str):
         mon._productive_seconds / max(1e-9, last_ts - mon._start_time)
     )
 
+    # -- per-phase loss breakdown (VERDICT r3 #2): align each kill
+    # with the next incarnation's lifecycle marks
+    marks = read_marks(progress)
+    step_time = 1.0 / max(clean_rate, 1e-9)
+    cycles = []
+    for k_ts in kill_times:
+        boot = next(
+            (t for n, t in marks if n == "boot" and t > k_ts), None
+        )
+        if boot is None:
+            continue
+        # marks from a LATER incarnation must not be attributed to
+        # this kill: bound the search at the next boot
+        next_boot = next(
+            (t for n, t in marks if n == "boot" and t > boot),
+            float("inf"),
+        )
+        restore = next(
+            (t for n, t in marks
+             if n == "restore" and boot <= t < next_boot),
+            None,
+        )
+        first = next(
+            (t for n, t in marks
+             if n == "first_step" and boot <= t < next_boot),
+            None,
+        )
+        best_before = max(
+            (s for t, s in entries if t <= k_ts), default=0
+        )
+        new_step = next(
+            (t for t, s in entries
+             if t > k_ts and s > best_before), None
+        )
+        if restore is None or first is None or new_step is None:
+            continue
+        cycles.append({
+            "detect_respawn_s": round(boot - k_ts, 3),
+            "restore_s": round(restore - boot, 3),
+            "retrace_first_step_s": round(first - restore, 3),
+            "refill_s": round(max(0.0, new_step - first), 3),
+            "total_lost_s": round(
+                max(0.0, new_step - k_ts - step_time), 3
+            ),
+        })
+    breakdown = {}
+    if cycles:
+        for key in cycles[0]:
+            vals = [c[key] for c in cycles]
+            breakdown[key] = {
+                "mean": round(sum(vals) / len(vals), 3),
+                "max": round(max(vals), 3),
+            }
+
     results["goodput"] = {
         "goodput_pct": round(goodput_pct, 1),
         "goodput_raw_pct": round(goodput_raw, 1),
@@ -1302,6 +1388,11 @@ def bench_goodput_churn(results: dict, workdir: str):
         "extrapolated_goodput_at_1_per_hour_pct": round(
             100 - (100 - goodput_pct) * kill_every / 3600.0, 2
         ),
+        # where each kill's lost time went: agent detection + warm
+        # fork, shm restore, jit re-trace (compile-cache hit) to the
+        # first step, then recomputing steps since the last ckpt
+        "phase_breakdown": breakdown,
+        "phase_cycles": cycles,
     }
 
 
@@ -1323,17 +1414,27 @@ def bench_elastic_recovery(results: dict, workdir: str):
         PYTHONPATH=os.getcwd(),
         DLROVER_SHARED_DIR=os.path.join(recovery_dir, "sock"),
     )
-    r = subprocess.run(
+    proc = _register_proc(subprocess.Popen(
         [
             sys.executable, "-m", "dlrover_tpu.run",
             "--nproc_per_node=1", "--max_restarts=2",
             "--monitor_interval=0.3",
             script, ckpt_dir, crash_flag, restored_flag, "kill",
         ],
-        env=env, cwd=os.getcwd(), capture_output=True, text=True,
-        timeout=600,
-    )
-    assert r.returncode == 0, r.stderr[-1500:]
+        env=env, cwd=os.getcwd(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True,
+    ))
+    try:
+        _, err = proc.communicate(timeout=600)
+    except subprocess.TimeoutExpired:
+        import signal as _signal
+
+        os.killpg(proc.pid, _signal.SIGKILL)
+        raise
+    finally:
+        if proc in _LIVE_PROCS:
+            _LIVE_PROCS.remove(proc)
+    assert proc.returncode == 0, err[-1500:]
     assert os.path.exists(crash_flag) and os.path.exists(restored_flag)
     recovery_s = os.path.getmtime(restored_flag) - os.path.getmtime(
         crash_flag

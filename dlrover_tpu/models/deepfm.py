@@ -21,6 +21,19 @@ import numpy as np
 from dlrover_tpu.ops.kv_variable import GroupAdamOptimizer, KvVariable
 
 
+def bce_with_logits(logits, labels):
+    """Numerically-stable binary cross entropy with logits — the one
+    loss both the monolithic step and the split-step pipeline train
+    against (a divergence here would compare tiers on different
+    objectives)."""
+    import jax.numpy as jnp
+
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
 @dataclass(frozen=True)
 class DeepFMConfig:
     num_sparse_fields: int = 26
@@ -110,10 +123,7 @@ class DeepFM:
 
         def loss_fn(dp, e):
             logits = self.apply(dp, e, dense_x)
-            return jnp.mean(
-                jnp.maximum(logits, 0) - logits * labels
-                + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-            )  # numerically-stable BCE-with-logits
+            return bce_with_logits(logits, labels)
 
         loss, (dense_grads, emb_grads) = jax.value_and_grad(
             loss_fn, argnums=(0, 1)

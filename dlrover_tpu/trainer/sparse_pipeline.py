@@ -32,8 +32,6 @@ from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
-from dlrover_tpu.common.log import default_logger as logger
-
 
 class SparseTrainPipeline:
     """Drive a hybrid host-sparse / device-dense train loop.
@@ -181,8 +179,9 @@ def make_deepfm_device_step(model, dense_optimizer):
     from functools import partial
 
     import jax
-    import jax.numpy as jnp
     import optax
+
+    from dlrover_tpu.models.deepfm import bce_with_logits
 
     @partial(jax.jit, donate_argnums=0)
     def device_step(dense_state, emb, dense_x, labels):
@@ -190,10 +189,7 @@ def make_deepfm_device_step(model, dense_optimizer):
 
         def loss_fn(dp, e):
             logits = model.apply(dp, e, dense_x)
-            return jnp.mean(
-                jnp.maximum(logits, 0) - logits * labels
-                + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-            )
+            return bce_with_logits(logits, labels)
 
         loss, (dgrads, egrads) = jax.value_and_grad(
             loss_fn, argnums=(0, 1)
