@@ -13,6 +13,7 @@ from dlrover_tpu.optim.came import came, q_adafactor, q_came
 from dlrover_tpu.optim.local_sgd import (
     diloco_outer_step,
     init_diloco,
+    reduce_deltas,
 )
 from dlrover_tpu.optim.low_bit import q_adamw
 from dlrover_tpu.optim.offload import adamw_offload, offload
@@ -24,6 +25,7 @@ __all__ = [
     "with_fp32_master",
     "came",
     "diloco_outer_step",
+    "reduce_deltas",
     "init_diloco",
     "offload",
     "q_adafactor",

@@ -2,16 +2,37 @@
 
 Reference: ``atorch/local_sgd/`` — patches torch FSDP to skip per-step
 gradient reduce and periodically runs an outer sync with reduction
-methods (linear averaging, task arithmetic).  The TPU-functional
-design: each data-parallel replica trains independently (params carry
-a leading replica dim sharded over the ``data`` axis, so *no* gradient
+methods (``reduce_methods/linear.py``,
+``reduce_methods/generalized_task_arithmetic.py``,
+``reduce_methods/sparsify.py``).  The TPU-functional design: each
+data-parallel replica trains independently (params carry a leading
+replica dim sharded over the ``data`` axis, so *no* gradient
 collective is emitted), and every H steps :func:`diloco_outer_step`
-averages the parameter *delta* across replicas and applies an outer
+reduces the parameter *delta* across replicas and applies an outer
 Nesterov-momentum update (the DiLoCo recipe) — one collective per H
 steps instead of per step, built for DCN-connected slices.
+
+Reduce methods (the ``reduce_method`` knob):
+
+- ``linear`` — plain replica mean (DiLoCo default).
+- ``gta`` — generalized task arithmetic: per-replica deltas are
+  optionally sparsified, a cross-replica consensus SIGN is computed
+  (majority by summed value or by sign count), elements disagreeing
+  with the majority are dropped, and the survivors are normalized by
+  how many replicas actually contributed per element.  Under
+  divergent replicas (heterogeneous data), sign conflicts cancel
+  noise instead of averaging it in.
+- ``sparsify`` — per-replica magnitude/random sparsification before
+  the mean (DARE-style): small-magnitude noise is dropped at the
+  source.
+
+Because replicas live on a stacked leading axis, every
+"cross-replica all-reduce" in the reference is an ``axis=0``
+reduction here — XLA lowers it to one ``psum`` over the ``data``
+mesh axis when the replica axis is sharded.
 """
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +70,93 @@ def replicate_for_local_training(params, mesh, num_replicas: int):
     )
 
 
+def _sparsify_deltas(
+    deltas, density: float, method: str, key
+):
+    """Per-replica sparsification of stacked deltas [R, ...]
+    (reference: ``reduce_methods/sparsify.py`` magnitude / random /
+    rescaled_random).  Magnitude keeps the top ``density`` fraction
+    by |value| per replica (quantile threshold — XLA-friendly,
+    equivalent to top-k up to ties)."""
+    if density >= 1.0:
+        return deltas
+    if method == "magnitude":
+        flat = jnp.abs(deltas).reshape(deltas.shape[0], -1)
+        thresh = jnp.quantile(flat, 1.0 - density, axis=1)
+        thresh = thresh.reshape((-1,) + (1,) * (deltas.ndim - 1))
+        return jnp.where(jnp.abs(deltas) >= thresh, deltas, 0.0)
+    if method in ("random", "rescaled_random"):
+        if key is None:
+            raise ValueError(
+                "random sparsification needs an rng key"
+            )
+        mask = jax.random.bernoulli(key, density, deltas.shape)
+        out = jnp.where(mask, deltas, 0.0)
+        if method == "rescaled_random":
+            out = out / density
+        return out
+    raise ValueError(f"unknown sparsification method {method!r}")
+
+
+def reduce_deltas(
+    deltas,                       # stacked [R, ...] per-replica deltas
+    reduce_method: str = "linear",
+    consensus: str = "sum",       # gta: "sum" | "count"
+    sparsification: Optional[str] = None,
+    density: float = 1.0,
+    weights=None,                 # optional per-replica weights [R]
+    key=None,                     # rng for random sparsification
+):
+    """Reduce per-replica deltas to one consensus delta (reference:
+    ``GTAReducer._reduce_tensor`` and ``sparsify``).  Everything is a
+    leading-axis reduction, so under a sharded replica axis XLA emits
+    exactly one psum chain per leaf."""
+    if reduce_method not in ("linear", "gta", "sparsify"):
+        raise ValueError(f"unknown reduce_method {reduce_method!r}")
+    if not 0.0 < density <= 1.0:
+        raise ValueError(f"density must be in (0, 1], got {density}")
+
+    def weighted_mean(d):
+        if weights is None:
+            return jnp.mean(d, axis=0)
+        w = jnp.asarray(weights, d.dtype).reshape(
+            (-1,) + (1,) * (d.ndim - 1)
+        )
+        return jnp.sum(d * w, axis=0) / jnp.sum(w)
+
+    if reduce_method == "linear":
+        return weighted_mean(deltas)
+    if reduce_method == "sparsify":
+        d = _sparsify_deltas(
+            deltas, density, sparsification or "magnitude", key
+        )
+        return weighted_mean(d)
+    # gta
+    d = deltas
+    if sparsification is not None:
+        d = _sparsify_deltas(d, density, sparsification, key)
+    if weights is not None:
+        w = jnp.asarray(weights, d.dtype).reshape(
+            (-1,) + (1,) * (d.ndim - 1)
+        )
+    else:
+        w = jnp.ones((d.shape[0],) + (1,) * (d.ndim - 1), d.dtype)
+    d = d * w
+    if consensus == "sum":
+        majority = jnp.where(jnp.sum(d, axis=0) >= 0, 1.0, -1.0)
+    elif consensus == "count":
+        majority = jnp.where(
+            jnp.sum(jnp.sign(d), axis=0) >= 0, 1.0, -1.0
+        )
+    else:
+        raise ValueError(f"unknown consensus {consensus!r}")
+    mask = (jnp.sign(d) == majority).astype(d.dtype)
+    d = d * mask
+    divisor = jnp.sum(mask * w, axis=0)
+    divisor = jnp.where(jnp.abs(divisor) < 1e-8, 1.0, divisor)
+    return jnp.sum(d, axis=0) / divisor
+
+
 def diloco_outer_step(
     local_params,          # stacked [R, ...] per-replica params
     state: DilocoState,
@@ -56,18 +164,34 @@ def diloco_outer_step(
     outer_lr: float = 0.7,
     outer_momentum: float = 0.9,
     nesterov: bool = True,
+    reduce_method: str = "linear",
+    consensus: str = "sum",
+    sparsification: Optional[str] = None,
+    density: float = 1.0,
+    key=None,
 ) -> Tuple[object, DilocoState]:
     """One outer DiLoCo update.
 
-    delta = anchor - mean_replica(local); momentum update on delta;
-    new anchor broadcast back to every replica.  The only collective
-    is the replica mean (one all-reduce over 'data' per H inner
-    steps).
+    Per-replica delta = anchor - local_r, reduced across replicas by
+    ``reduce_method`` (see module docstring); momentum update on the
+    reduced delta; new anchor broadcast back to every replica.  The
+    only collective is the replica reduction (one all-reduce chain
+    over 'data' per H inner steps).
     """
+    leaf_idx = [0]
 
     def per_leaf(local, anchor, mom):
-        mean_local = jnp.mean(local, axis=0)  # replica mean
-        delta = anchor - mean_local           # "outer gradient"
+        deltas = anchor[None] - local         # [R, ...] per replica
+        leaf_key = (
+            jax.random.fold_in(key, leaf_idx[0])
+            if key is not None else None
+        )
+        leaf_idx[0] += 1
+        delta = reduce_deltas(
+            deltas, reduce_method=reduce_method, consensus=consensus,
+            sparsification=sparsification, density=density,
+            key=leaf_key,
+        )
         new_mom = outer_momentum * mom + delta
         step = (
             outer_momentum * new_mom + delta if nesterov else new_mom
