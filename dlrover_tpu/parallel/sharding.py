@@ -92,6 +92,9 @@ def sharding_tree(tree, mesh, rules: PartitionRules):
     return jax.tree_util.tree_map_with_path(to_sharding, tree)
 
 
+_WARNED_MISSING_AXES = set()
+
+
 def _fit_spec(spec, shape, mesh):
     from jax.sharding import PartitionSpec
 
@@ -103,6 +106,27 @@ def _fit_spec(spec, shape, mesh):
             fitted.append(None)
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
+        # axes the mesh does not have are replicated — the same rule
+        # set then serves differently-factorized meshes (e.g. the TP
+        # rules, written for a dp x fsdp x tensor training mesh,
+        # applied to a data x tensor rollout mesh).  Warn once per
+        # axis so a typo'd rule doesn't silently unshard a model.
+        missing = [a for a in axes if a not in mesh.shape]
+        for a in missing:
+            if a not in _WARNED_MISSING_AXES:
+                _WARNED_MISSING_AXES.add(a)
+                from dlrover_tpu.common.log import default_logger
+
+                default_logger.warning(
+                    "partition spec names mesh axis %r which mesh %s "
+                    "does not have; replicating that dimension",
+                    a, dict(mesh.shape),
+                )
+        axes = tuple(a for a in axes if a in mesh.shape)
+        if not axes:
+            fitted.append(None)
+            continue
+        entry = axes if len(axes) > 1 else axes[0]
         size = 1
         for a in axes:
             size *= mesh.shape[a]

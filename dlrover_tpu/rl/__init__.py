@@ -3,6 +3,7 @@ managing actor/critic/ref/reward models each with its own
 acceleration strategy, DeepSpeed-hybrid-engine re-implementation, PPO
 utilities)."""
 
+from dlrover_tpu.rl.hybrid_engine import HybridRolloutEngine
 from dlrover_tpu.rl.model_engine import ModelRole, RLModelEngine
 from dlrover_tpu.rl.ppo import (
     gae_advantages,
@@ -11,6 +12,7 @@ from dlrover_tpu.rl.ppo import (
 )
 
 __all__ = [
+    "HybridRolloutEngine",
     "ModelRole",
     "RLModelEngine",
     "gae_advantages",

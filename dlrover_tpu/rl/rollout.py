@@ -86,15 +86,25 @@ def ppo_iteration(
     gamma: float = 1.0,
     lam: float = 0.95,
     reward_fn: Callable = None,
+    hybrid=None,
 ) -> Dict[str, float]:
     """One full PPO iteration: rollout -> score -> GAE -> two PPO
     steps.  ``reward_fn(sequences) -> [b]`` overrides the reward role
     (otherwise the reward model scores the final token).
+
+    ``hybrid`` (a :class:`dlrover_tpu.rl.hybrid_engine.
+    HybridRolloutEngine`) swaps the actor into its rollout layout for
+    generation — train and rollout may use different meshes; the
+    timed reshard latency lands in the returned metrics.
     Returns metrics including the mean sequence reward."""
     b, prompt_len = prompts.shape
     actor = engine._roles[ModelRole.ACTOR].model
     actor_decode = decode_variant(actor)
-    actor_params = engine.state(ModelRole.ACTOR).params
+    if hybrid is not None:
+        actor_params = hybrid.reshard_actor_for_rollout()
+        prompts = hybrid.place_rollout_batch(prompts)
+    else:
+        actor_params = engine.state(ModelRole.ACTOR).params
 
     sequences, old_logps = generate(
         actor_decode, actor_params, prompts, rng,
@@ -144,8 +154,11 @@ def ppo_iteration(
         engine.set_state(role, state)
         losses[f"{role}_loss"] = float(metrics["loss"])
 
-    return {
+    metrics = {
         "mean_reward": float(seq_reward.mean()),
         "mean_kl": float(kl.mean()),
         **losses,
     }
+    if hybrid is not None:
+        metrics["reshard_s"] = hybrid.reshard_times[-1]
+    return metrics

@@ -236,3 +236,105 @@ def test_ppo_iteration_improves_reward():
         engine.state(ModelRole.ACTOR).params
     )[0]
     assert ref_leaf is not actor_leaf
+
+
+def test_ppo_hybrid_rollout_resharding_improves_reward():
+    """Train and rollout run on DIFFERENT layouts (reference:
+    atorch/rl/ds_hybrid_engine + model_engine.py:35): the actor
+    trains fsdp-sharded on a dp x fsdp mesh, generation swaps its
+    params into a tensor-parallel layout on a dp x tensor mesh via
+    one timed device_put, and PPO still improves the reward."""
+    import optax as _optax
+    from jax.sharding import Mesh
+
+    from dlrover_tpu.accel import Strategy
+    from dlrover_tpu.rl.hybrid_engine import HybridRolloutEngine
+    from dlrover_tpu.rl.rollout import (
+        make_actor_loss,
+        make_critic_loss,
+        ppo_iteration,
+        sample_rollout_batch,
+    )
+
+    cfg = GPTConfig.tiny(max_seq_len=64, vocab_size=32)
+    actor_model = GPT(cfg)
+    critic_model = GPT(
+        GPTConfig.tiny(max_seq_len=64, vocab_size=32, head="value")
+    )
+    ref_model = GPT(cfg)
+
+    prompt_len, max_new = 4, 8
+    prompts = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, prompt_len), dtype=np.int32
+        )
+    )
+    sample = sample_rollout_batch(prompts, max_new)
+    actor_params = actor_model.init_params(jax.random.PRNGKey(1))
+    engine = RLModelEngine(sample, {
+        ModelRole.ACTOR: RoleSpec(
+            model=actor_model,
+            loss_fn=make_actor_loss(actor_model, prompt_len),
+            optim_factory=lambda: _optax.adam(5e-3),
+            # TRAIN layout: fsdp-sharded state
+            strategy=Strategy(opts=[("fsdp", {})]),
+        ),
+        ModelRole.CRITIC: RoleSpec(
+            model=critic_model,
+            loss_fn=make_critic_loss(critic_model, prompt_len),
+            optim_factory=lambda: _optax.adam(1e-3),
+            strategy=Strategy(opts=[("parallel_mode", {})]),
+        ),
+        ModelRole.REF: RoleSpec(model=ref_model, params=actor_params),
+    }).build()
+
+    # ROLLOUT layout: 2-way batch x 4-way tensor slicing
+    rollout_mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 4),
+        ("data", "tensor"),
+    )
+    train_mesh = engine._accel[ModelRole.ACTOR].mesh
+    assert rollout_mesh.shape != dict(train_mesh.shape)
+    hybrid = HybridRolloutEngine(engine, rollout_mesh)
+
+    def reward_fn(sequences):
+        resp = sequences[:, prompt_len:]
+        return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+    rng = jax.random.PRNGKey(2)
+    rewards, reshards = [], []
+    for i in range(10):
+        rng, sub = jax.random.split(rng)
+        metrics = ppo_iteration(
+            engine, prompts, sub, max_new_tokens=max_new,
+            kl_coef=0.01, reward_fn=reward_fn, hybrid=hybrid,
+        )
+        rewards.append(metrics["mean_reward"])
+        reshards.append(metrics["reshard_s"])
+    assert np.mean(rewards[-3:]) > np.mean(rewards[:3]) + 0.05, rewards
+    assert hybrid.stats()["reshards"] == 10
+    # the swap actually changed a leaf's layout: the rollout copy of
+    # a tensor-sliced kernel is sharded differently from the train
+    # (fsdp) state's same leaf
+    rolled = hybrid.reshard_actor_for_rollout()
+    train_params = engine.state(ModelRole.ACTOR).params
+    paths_r = jax.tree_util.tree_leaves_with_path(rolled)
+    paths_t = dict(
+        ("/".join(str(k) for k in p), l)
+        for p, l in jax.tree_util.tree_leaves_with_path(train_params)
+    )
+    changed = 0
+    for p, leaf in paths_r:
+        key = "/".join(str(k) for k in p)
+        if not leaf.sharding.is_equivalent_to(
+            paths_t[key].sharding, leaf.ndim
+        ):
+            changed += 1
+    assert changed > 0
+    specs = jax.tree_util.tree_leaves(
+        hybrid._target_shardings,
+        is_leaf=lambda s: hasattr(s, "spec"),
+    )
+    assert any(
+        "tensor" in str(s.spec) for s in specs
+    ), [str(s.spec) for s in specs[:5]]
