@@ -406,6 +406,67 @@ def bench_input_pipeline(jax, results: dict):
         "input_bound_pct": round(100 * input_wait / wall, 2),
     }
 
+    # coworker leg: a DATA-HOST PROCESS serves the same batches over
+    # the comm layer (reference: coworker_data_service.py:1 CPU pods
+    # feeding accelerator pods); input-bound fraction must stay small
+    # across the host boundary too
+    from dlrover_tpu.trainer.coworker import CoworkerDataLoader
+
+    co_steps = 8
+    host_script = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {os.getcwd()!r})\n"
+        "from dlrover_tpu.trainer.coworker import "
+        "CoworkerDataService\n"
+        "from bench import _read_tokens\n"
+        "svc = CoworkerDataService(read_fn=_read_tokens, "
+        f"batch_size={batch}, index_iter=range({batch * co_steps}), "
+        "num_workers=2, host='127.0.0.1').start()\n"
+        "print(f'PORT {svc.port}', flush=True)\n"
+        "while True:\n"
+        "    time.sleep(0.5)\n"
+    )
+    data_host = subprocess.Popen(
+        [sys.executable, "-c", host_script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=os.getcwd(),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    try:
+        port_line = data_host.stdout.readline()
+        if not port_line.startswith("PORT"):
+            err = data_host.stderr.read()[-500:]
+            raise RuntimeError(
+                f"coworker data host failed to start: {err}"
+            )
+        co_loader = CoworkerDataLoader(
+            "127.0.0.1:" + port_line.split()[1]
+        )
+        co_it = iter(co_loader)
+        # warm-up batch excludes connect + first un-pipelined round
+        # trip, mirroring the shm leg's spin-up exclusion
+        state, loss = step(state, jnp.asarray(next(co_it)))
+        float(loss)
+        co_wait0 = co_loader.stats()["input_wait_s"]
+        t0 = time.perf_counter()
+        co_n = 0
+        for host_batch in co_it:
+            state, loss = step(state, jnp.asarray(host_batch))
+            co_n += 1
+        float(loss)
+        co_wall = time.perf_counter() - t0
+        co_wait = co_loader.stats()["input_wait_s"] - co_wait0
+    finally:
+        data_host.kill()
+        data_host.wait()
+    results["input_pipeline"]["coworker"] = {
+        "loader": "coworker data-host process over TCP",
+        "steps": co_n,
+        "step_wall_s": round(co_wall / max(1, co_n), 4),
+        "input_wait_s": round(co_wait, 4),
+        "input_bound_pct": round(100 * co_wait / max(co_wall, 1e-9), 2),
+    }
+
 
 def _read_tokens(i: int):
     """Module-level (picklable) synthetic sample for the input bench."""

@@ -64,6 +64,10 @@ _ALLOWED_GLOBALS = {
     ("numpy.core.multiarray", "scalar"),
     ("numpy._core.multiarray", "_reconstruct"),
     ("numpy._core.multiarray", "scalar"),
+    # contiguous-array fast path (protocol 5 pickles of ndarrays):
+    # a pure reconstructor, builds an ndarray from raw bytes
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
 }
 
 
@@ -233,11 +237,23 @@ class MessageServer:
     """Threaded request server (role of create_master_service,
     reference servicer.py:630)."""
 
-    def __init__(self, port: int, handler: RequestHandler, host: str = "0.0.0.0"):
+    def __init__(
+        self,
+        port: int,
+        handler: RequestHandler,
+        host: str = "0.0.0.0",
+        cache_capacity: int = 8192,
+    ):
+        """``cache_capacity`` bounds the idempotent-retry response
+        cache; servers whose responses are LARGE (e.g. the coworker
+        data service shipping whole batches) should size it to what
+        memory affords x the retry window they must cover."""
         self.handler = handler
         self._server = _ThreadingTCPServer((host, port), _Connection)
         self._server.handler = handler  # type: ignore[attr-defined]
-        self._server.response_cache = ResponseCache()  # type: ignore[attr-defined]
+        self._server.response_cache = ResponseCache(  # type: ignore[attr-defined]
+            capacity=cache_capacity
+        )
         self._thread: Optional[threading.Thread] = None
         self.port = self._server.server_address[1]
 

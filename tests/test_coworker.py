@@ -1,0 +1,151 @@
+"""Coworker disaggregated data plane (reference:
+atorch/service/coworker_data_service.py:1 + data/coworker_dataset.py
++ distributed.py:565): a DATA-HOST PROCESS builds batches and streams
+them over the comm layer into trainer-side loaders."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.trainer.coworker import (
+    CoworkerDataLoader,
+    CoworkerDataService,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DATA_HOST_SCRIPT = r'''
+import sys, time
+import numpy as np
+sys.path.insert(0, %(repo)r)
+from dlrover_tpu.trainer.coworker import CoworkerDataService
+
+def read_sample(i):
+    rng = np.random.default_rng(i)
+    return {"x": rng.standard_normal(8).astype(np.float32),
+            "y": np.int32(i)}
+
+svc = CoworkerDataService(
+    read_fn=read_sample, batch_size=4, index_iter=range(32),
+    num_workers=2, port=0, host="127.0.0.1",
+).start()
+print(f"PORT {svc.port}", flush=True)
+while True:
+    time.sleep(0.5)
+'''
+
+
+def _expected_x(i):
+    return np.random.default_rng(i).standard_normal(8).astype(
+        np.float32
+    )
+
+
+def test_coworker_two_process_e2e():
+    """Real data-host process, real TCP: every sample arrives exactly
+    once with correct content; input-wait accounting works."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", DATA_HOST_SCRIPT % {"repo": REPO}],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT"), line
+        port = int(line.split()[1])
+        loader = CoworkerDataLoader(f"127.0.0.1:{port}")
+        seen = {}
+        for batch in loader:
+            assert set(batch) == {"x", "y"}
+            for row in range(batch["y"].shape[0]):
+                i = int(batch["y"][row])
+                assert i not in seen, "duplicate sample"
+                seen[i] = np.array(batch["x"][row])
+        assert sorted(seen) == list(range(32))
+        for i, x in seen.items():
+            np.testing.assert_array_equal(x, _expected_x(i))
+        stats = loader.stats()
+        assert stats["batches"] == 8
+        assert stats["input_wait_s"] >= 0.0
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_coworker_dynamic_sharding_two_consumers():
+    """One service, two consumers (the reference's data service feeds
+    many accelerator pods): batches are disjoint and together cover
+    the dataset exactly once."""
+    svc = CoworkerDataService(
+        read_fn=lambda i: {"y": np.int32(i)}, batch_size=2,
+        index_iter=range(20), num_workers=2, host="127.0.0.1",
+    ).start()
+    try:
+        addr = f"127.0.0.1:{svc.port}"
+        a = CoworkerDataLoader(addr, node_id=0)
+        b = CoworkerDataLoader(addr, node_id=1)
+        got_a, got_b = [], []
+        it_a, it_b = iter(a), iter(b)
+        done_a = done_b = False
+        while not (done_a and done_b):
+            if not done_a:
+                try:
+                    got_a.extend(int(v) for v in next(it_a)["y"])
+                except StopIteration:
+                    done_a = True
+            if not done_b:
+                try:
+                    got_b.extend(int(v) for v in next(it_b)["y"])
+                except StopIteration:
+                    done_b = True
+        assert not (set(got_a) & set(got_b))
+        assert sorted(got_a + got_b) == list(range(20))
+        assert svc.stats()["served"] == 10
+    finally:
+        svc.stop()
+
+
+def test_coworker_input_bound_fraction_with_train_loop():
+    """The measurable claim: with service-side prefetch, a consumer
+    that does real work between batches waits a SMALL fraction of
+    wall time on input (the reference's wait-free pitch)."""
+    svc = CoworkerDataService(
+        read_fn=lambda i: {
+            "x": np.full((64, 64), float(i), np.float32)
+        },
+        batch_size=4, index_iter=range(40), num_workers=2,
+        queue_depth=8, host="127.0.0.1",
+    ).start()
+    try:
+        loader = CoworkerDataLoader(f"127.0.0.1:{svc.port}")
+        t0 = time.perf_counter()
+        n = 0
+        for batch in loader:
+            time.sleep(0.02)  # stand-in for the device step
+            n += 1
+        wall = time.perf_counter() - t0
+        frac = loader.stats()["input_wait_s"] / wall
+        assert n == 10
+        assert frac < 0.5, frac
+    finally:
+        svc.stop()
+
+
+def test_coworker_service_error_surfaces():
+    def bad_read(i):
+        raise IOError("disk on fire")
+
+    svc = CoworkerDataService(
+        read_fn=bad_read, batch_size=2, index_iter=range(4),
+        host="127.0.0.1",
+    ).start()
+    try:
+        loader = CoworkerDataLoader(f"127.0.0.1:{svc.port}")
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            list(loader)
+    finally:
+        svc.stop()
