@@ -267,29 +267,36 @@ def bench_xl_train_step(jax, results: dict):
         max_seq_len=seq, attention_impl="flash", remat=True,
         param_dtype=jnp.bfloat16,
     )
+    def make_step(model, opt):
+        """ONE step recipe for every XL leg — the offload-vs-remat
+        comparison must measure the same step as the headline."""
+
+        @partial(jax.jit, donate_argnums=0)
+        def step(state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p, t: cross_entropy_loss(
+                    model.apply({"params": p}, t[:, :-1]), t[:, 1:]
+                )
+            )(state.params, tokens)
+            updates, new_opt = opt.update(
+                grads, state.opt_state, state.params
+            )
+            return (
+                TrainState(
+                    params=optax.apply_updates(state.params, updates),
+                    opt_state=new_opt, step=state.step + 1,
+                ),
+                loss,
+            )
+
+        return step
+
     model = GPT(cfg)
     params = model.init_params(jax.random.PRNGKey(0), seq_len=seq)
     opt = q_adamw(learning_rate=3e-4, weight_decay=0.1)
     state = TrainState.create(params, opt)
     n = count_params(params)
-
-    @partial(jax.jit, donate_argnums=0)
-    def step(state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p, t: cross_entropy_loss(
-                model.apply({"params": p}, t[:, :-1]), t[:, 1:]
-            )
-        )(state.params, tokens)
-        updates, new_opt = opt.update(
-            grads, state.opt_state, state.params
-        )
-        return (
-            TrainState(
-                params=optax.apply_updates(state.params, updates),
-                opt_state=new_opt, step=state.step + 1,
-            ),
-            loss,
-        )
+    step = make_step(model, opt)
 
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(
@@ -322,6 +329,57 @@ def bench_xl_train_step(jax, results: dict):
         "mfu": round(flops_per_token * tokens_per_s / peak, 4),
         "loss_first": loss0,
         "loss": loss,
+    }
+    del state, tokens
+
+    # selective activation offload (reference:
+    # selective_offloading_checkpoint.py:1): the lever exists to fit
+    # shapes plain remat cannot — push the SAME model to seq 2048 and
+    # run both remat policies; whichever OOMs is recorded honestly
+    def try_xl(seq2, batch2, policy):
+        cfg2 = GPTConfig(
+            num_layers=48, num_heads=25, hidden_dim=1600,
+            max_seq_len=seq2, attention_impl="flash", remat=True,
+            remat_policy=policy, param_dtype=jnp.bfloat16,
+        )
+        model2 = GPT(cfg2)
+        try:
+            params2 = model2.init_params(
+                jax.random.PRNGKey(0), seq_len=seq2
+            )
+            opt2 = q_adamw(learning_rate=3e-4, weight_decay=0.1)
+            state2 = TrainState.create(params2, opt2)
+            step2 = make_step(model2, opt2)
+
+            toks = jnp.asarray(
+                np.random.default_rng(0).integers(
+                    0, cfg2.vocab_size, (batch2, seq2 + 1),
+                    dtype=np.int32,
+                )
+            )
+            state2, l2 = step2(state2, toks)
+            float(l2)
+            t0 = time.perf_counter()
+            for _ in range(4):
+                state2, l2 = step2(state2, toks)
+            l2 = float(l2)
+            dt2 = (time.perf_counter() - t0) / 4
+            return {
+                "ok": True, "step_time_s": round(dt2, 4),
+                "tokens_per_s": round(batch2 * seq2 / dt2, 1),
+                "loss": l2,
+            }
+        except Exception as e:  # noqa: BLE001 - OOM is the finding
+            return {"ok": False, "error": f"{type(e).__name__}: "
+                    + str(e)[:200]}
+
+    seq2, batch2 = 2048, 4
+    results["xl_act_offload"] = {
+        "model": "gpt2_xl",
+        "seq_len": seq2,
+        "batch": batch2,
+        "offload": try_xl(seq2, batch2, "offload"),
+        "plain_remat_control": try_xl(seq2, batch2, "full"),
     }
 
 
@@ -1684,7 +1742,7 @@ def main() -> int:
          lambda: bench_flash_ckpt(jax, results, workdir), 280),
         ("auto_config", lambda: bench_auto_config(jax, results), 210),
         ("xl_train_step",
-         lambda: bench_xl_train_step(jax, results), 180),
+         lambda: bench_xl_train_step(jax, results), 300),
         ("attention_kernel",
          lambda: bench_attention_kernel(jax, results), 120),
         ("gqa_attention_kernel",

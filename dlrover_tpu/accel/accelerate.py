@@ -81,6 +81,11 @@ def _apply_plan_to_model(plan: AccelPlan, context: ModelContext):
     updates: Dict[str, Any] = {}
     if hasattr(cfg, "remat") and plan.remat != cfg.remat:
         updates["remat"] = plan.remat
+    if (
+        hasattr(cfg, "remat_policy")
+        and plan.remat_policy != cfg.remat_policy
+    ):
+        updates["remat_policy"] = plan.remat_policy
     attention_impl = plan.attention_impl
     if plan.sequence_parallel == "ring":
         attention_impl = "ring"
@@ -151,6 +156,24 @@ def build_from_plan(
     from dlrover_tpu.parallel.mesh import set_global_mesh
 
     set_global_mesh(mesh)  # ring/ulysses attention resolve it
+    if (
+        plan.remat_policy == "offload"
+        and mesh.devices.flat[0].platform == "cpu"
+    ):
+        # the offload policy compiles on single-device cpu, but the
+        # cpu SPMD partitioner rejects its annotate_device_placement
+        # custom-call ("Side-effect HLO must have sharding") — the
+        # same platform ceiling as opt-state offload.  Degrade so the
+        # plan stays runnable on the virtual test mesh; on TPU GSPMD
+        # this is the supported host-offloading path.
+        logger.warning(
+            "offload_activation: pinned_host under the sharded step "
+            "is TPU-only; degrading to plain remat on cpu"
+        )
+        note = "offload_activation degraded to plain remat on cpu"
+        if note not in plan.notes:
+            plan.notes.append(note)
+        plan.remat_policy = "full"
     model = _apply_plan_to_model(plan, context)
     if plan.mesh_config.pipeline > 1:
         # route the block stack through the GPipe schedule; the plan's

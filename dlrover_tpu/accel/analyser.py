@@ -147,6 +147,7 @@ def fits_in_hbm(
     seq_shards: int = 1, expert_shards: int = 1,
     expert_param_fraction: float = 0.5,
     half: bool = False, low_bit_opt: bool = False,
+    act_offload: bool = False,
 ) -> bool:
     """Rough memory feasibility check for a candidate plan (the role
     of the reference's dryrun memory profiling, cheaper).
@@ -175,7 +176,11 @@ def fits_in_hbm(
         analysis.batch_bytes * activation_factor
         / max(1, seq_shards)
     )
-    if remat:
+    if act_offload:
+        # selective offload: per-block residual checkpoints live in
+        # pinned_host; HBM holds ~one block's working set
+        act *= 0.1
+    elif remat:
         act *= 0.35
     headroom = 0.9 * analysis.per_device_hbm
     return state + act < headroom

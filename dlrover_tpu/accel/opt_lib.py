@@ -258,6 +258,26 @@ class CheckpointOpt(Optimization):
         return plan
 
 
+class SelectiveOffloadCheckpointOpt(Optimization):
+    """Selective offloading activation checkpoint (reference:
+    auto/opt_lib/selective_offloading_checkpoint.py:1): remat whose
+    per-block residual checkpoints live in pinned_host between
+    forward and backward instead of HBM — activation memory drops to
+    ~one block's working set at the price of D2H/H2D streams the
+    scheduler overlaps with compute.  TPU-gated in build_from_plan
+    (the cpu backend has no pinned_host under jit)."""
+
+    name = "offload_activation"
+
+    def apply(self, plan, config, context=None):
+        plan.remat = True
+        plan.remat_policy = "offload"
+        plan.notes.append(
+            "activation remat with pinned_host checkpoint offload"
+        )
+        return plan
+
+
 class ModuleReplaceOpt(Optimization):
     """Kernel swap-in: flash attention (reference:
     module_replace_optimization.py swapping HF attention for
@@ -335,7 +355,8 @@ class OptimizationLibrary:
             ParallelModeOpt, Zero1Opt, Zero2Opt, FSDPOpt,
             TensorParallelOpt, SequenceParallelOpt, ExpertParallelOpt,
             MixedParallelOpt, AmpNativeOpt, HalfOpt, Fp8Opt,
-            CheckpointOpt, ModuleReplaceOpt, PipelineParallelOpt,
+            CheckpointOpt, SelectiveOffloadCheckpointOpt,
+            ModuleReplaceOpt, PipelineParallelOpt,
             OffloadOptStateOpt, LowBitOptimizerOpt,
         ):
             self.register(cls())

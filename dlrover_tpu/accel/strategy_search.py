@@ -33,6 +33,7 @@ class Candidate:
     sequence: int = 1
     expert: int = 1
     remat: bool = False
+    act_offload: bool = False   # remat + pinned_host checkpoints
     grad_accum: int = 1
     half: bool = False          # bf16 param storage
     low_bit_opt: bool = False   # int8 optimizer moments
@@ -46,6 +47,7 @@ class Candidate:
             "log_seq": math.log2(self.sequence),
             "log_expert": math.log2(self.expert),
             "remat": float(self.remat),
+            "act_offload": float(self.act_offload),
             "log_accum": math.log2(self.grad_accum),
             "half": float(self.half),
             "low_bit": float(self.low_bit_opt),
@@ -57,6 +59,7 @@ class Candidate:
             f"{f'xsp{self.sequence}' if self.sequence > 1 else ''}"
             f"{f'xep{self.expert}' if self.expert > 1 else ''}"
             f"{'+remat' if self.remat else ''}"
+            f"{'+actoffload' if self.act_offload else ''}"
             f"{f'+ga{self.grad_accum}' if self.grad_accum > 1 else ''}"
             f"{'+half' if self.half else ''}"
             f"{'+int8opt' if self.low_bit_opt else ''}"
@@ -81,6 +84,7 @@ def _build_strategy(
     data: int, fsdp: int, tensor: int, remat: bool, grad_accum: int,
     sequence: int = 1, expert: int = 1,
     half: bool = False, low_bit_opt: bool = False,
+    act_offload: bool = False,
 ) -> Strategy:
     opts: List[Tuple[str, Dict]] = []
     if tensor > 1 or expert > 1 or (fsdp > 1 and sequence > 1):
@@ -100,7 +104,9 @@ def _build_strategy(
     opts.append(("half", {}) if half else ("amp_native", {}))
     if low_bit_opt:
         opts.append(("low_bit_opt", {"bits": 8}))
-    if remat:
+    if act_offload:
+        opts.append(("offload_activation", {}))
+    elif remat:
         opts.append(("checkpoint", {}))
     import jax
 
@@ -176,19 +182,32 @@ def generate_candidates(
             ):
                 if lowbit and not search_opt:
                     continue
-                for remat in (False, True):
+                # act_offload (pinned_host checkpoints) is a MEMORY
+                # fallback lever: only emitted when plain remat does
+                # not fit (it adds D2H/H2D traffic, never wins on
+                # speed when remat alone fits)
+                for remat, act_off in (
+                    (False, False), (True, False), (True, True),
+                ):
+                    if act_off and fits_in_hbm(
+                        analysis, fsdp, tp, True,
+                        seq_shards=sp, expert_shards=ep,
+                        half=half, low_bit_opt=lowbit,
+                    ):
+                        continue
                     if not fits_in_hbm(
                         analysis, fsdp, tp, remat,
                         seq_shards=sp, expert_shards=ep,
                         half=half, low_bit_opt=lowbit,
+                        act_offload=act_off,
                     ):
                         continue
                     for ga in grad_accums:
                         if batch % (ga * max(1, data * fsdp)):
                             continue
                         key = (
-                            data, fsdp, tp, sp, ep, remat, ga,
-                            half, lowbit,
+                            data, fsdp, tp, sp, ep, remat, act_off,
+                            ga, half, lowbit,
                         )
                         if key in seen:
                             continue
@@ -198,10 +217,12 @@ def generate_candidates(
                                 data, fsdp, tp, remat, ga,
                                 sequence=sp, expert=ep,
                                 half=half, low_bit_opt=lowbit,
+                                act_offload=act_off,
                             ),
                             data=data, fsdp=fsdp, tensor=tp,
                             sequence=sp, expert=ep,
-                            remat=remat, grad_accum=ga,
+                            remat=remat, act_offload=act_off,
+                            grad_accum=ga,
                             half=half, low_bit_opt=lowbit,
                         ))
     if not cands:
@@ -209,14 +230,23 @@ def generate_candidates(
         # memory-frugal plan and let the dry run surface the OOM
         logger.warning(
             "no candidate passed the HBM model; falling back to "
-            "fsdp x remat x half x int8-opt"
+            "fsdp x remat(+offload) x half x int8-opt"
+        )
+        # the frugalest plan available: pinned_host activation
+        # checkpoints when even plain remat's 0.35x activation
+        # footprint was what failed the check
+        fb_offload = not fits_in_hbm(
+            analysis, num_devices, 1, True, half=True,
+            low_bit_opt=True,
         )
         cands.append(Candidate(
             strategy=_build_strategy(
                 1, num_devices, 1, True, grad_accums[0],
                 half=True, low_bit_opt=True,
+                act_offload=fb_offload,
             ),
             data=1, fsdp=num_devices, tensor=1, remat=True,
+            act_offload=fb_offload,
             grad_accum=grad_accums[0], half=True, low_bit_opt=True,
         ))
     return cands
@@ -376,6 +406,7 @@ def search_strategy(
             Parameter("log_seq", 0.0, math.log2(num_devices)),
             Parameter("log_expert", 0.0, math.log2(num_devices)),
             Parameter("remat", 0.0, 1.0),
+            Parameter("act_offload", 0.0, 1.0),
             Parameter("log_accum", 0.0, math.log2(max(grad_accums))),
             Parameter("half", 0.0, 1.0),
             Parameter("low_bit", 0.0, 1.0),

@@ -42,6 +42,13 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16       # activation/compute dtype (MXU)
     param_dtype: Any = jnp.float32  # master params
     remat: bool = False
+    # remat policy: "full" recomputes everything; "offload" keeps the
+    # per-block residual checkpoints but parks them in host memory
+    # (pinned_host) between forward and backward — activation HBM
+    # drops to ~one block's working set (reference:
+    # auto/opt_lib/selective_offloading_checkpoint.py:1).  TPU-only:
+    # the cpu backend has no pinned_host placement under jit.
+    remat_policy: str = "full"
     # "xla" = dot-product attention lowered by XLA; "flash" = Pallas
     attention_impl: str = "xla"
     tie_embeddings: bool = True
@@ -84,6 +91,24 @@ class GPTConfig:
             num_layers=48, num_heads=25, hidden_dim=1600,
             max_seq_len=1024, **kw,
         )
+
+
+def _remat_policy(name: str):
+    """None = recompute everything (plain remat); "offload" parks
+    the named per-block residual checkpoints in pinned_host between
+    forward and backward (selective offloading checkpoint)."""
+    if name in ("full", "", None):
+        return None
+    if name == "offload":
+        import jax
+
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["block_in"],
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+    raise ValueError(f"unknown remat_policy {name!r}")
 
 
 def xla_causal_attention(
@@ -257,6 +282,11 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
+        # named so the offload remat policy can select the residual
+        # stream (a no-op under other policies)
+        from jax.ad_checkpoint import checkpoint_name
+
+        x = checkpoint_name(x, "block_in")
         # fp32 layernorms on the residual stream for stability
         h = nn.LayerNorm(
             epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_attn"
@@ -315,7 +345,10 @@ class GPT(nn.Module):
         x = wte(tokens) + wpe(offset + jnp.arange(s)[None])
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            block = nn.remat(
+                Block, prevent_cse=False,
+                policy=_remat_policy(cfg.remat_policy),
+            )
         for i in range(cfg.num_layers):
             use_moe = (
                 # shared convention with Llama: every moe_every-th

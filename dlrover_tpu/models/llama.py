@@ -37,6 +37,7 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: str = "full"  # "full" | "offload" (see gpt.py)
     attention_impl: str = "xla"
     # KV-cache decoding (same contract as GPTConfig.decode): RoPE uses
     # absolute positions continued across chunks; the cache stores
@@ -219,6 +220,10 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
+        # named for the offload remat policy (no-op otherwise)
+        from jax.ad_checkpoint import checkpoint_name
+
+        x = checkpoint_name(x, "block_in")
         h = RMSNorm(cfg.rms_eps, name="ln_attn")(x)
         x = x + LlamaAttention(cfg, name="attn")(h)
         h = RMSNorm(cfg.rms_eps, name="ln_mlp")(x)
@@ -257,7 +262,12 @@ class Llama(nn.Module):
         )(tokens)
         block = LlamaBlock
         if cfg.remat:
-            block = nn.remat(LlamaBlock, prevent_cse=False)
+            from dlrover_tpu.models.gpt import _remat_policy
+
+            block = nn.remat(
+                LlamaBlock, prevent_cse=False,
+                policy=_remat_policy(cfg.remat_policy),
+            )
         for i in range(cfg.num_layers):
             # shared convention with GPT: every moe_every-th block,
             # counting from the end of the first stride (moe_every=1
