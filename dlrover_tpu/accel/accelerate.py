@@ -156,6 +156,7 @@ def build_from_plan(
     from dlrover_tpu.parallel.mesh import set_global_mesh
 
     set_global_mesh(mesh)  # ring/ulysses attention resolve it
+    model_plan = plan
     if (
         plan.remat_policy == "offload"
         and mesh.devices.flat[0].platform == "cpu"
@@ -163,9 +164,10 @@ def build_from_plan(
         # the offload policy compiles on single-device cpu, but the
         # cpu SPMD partitioner rejects its annotate_device_placement
         # custom-call ("Side-effect HLO must have sharding") — the
-        # same platform ceiling as opt-state offload.  Degrade so the
-        # plan stays runnable on the virtual test mesh; on TPU GSPMD
-        # this is the supported host-offloading path.
+        # same platform ceiling as opt-state offload.  Degrade THIS
+        # BUILD only (the caller's plan stays declarative: the same
+        # plan later built on TPU keeps its offload lever); on TPU
+        # GSPMD this is the supported host-offloading path.
         logger.warning(
             "offload_activation: pinned_host under the sharded step "
             "is TPU-only; degrading to plain remat on cpu"
@@ -173,8 +175,8 @@ def build_from_plan(
         note = "offload_activation degraded to plain remat on cpu"
         if note not in plan.notes:
             plan.notes.append(note)
-        plan.remat_policy = "full"
-    model = _apply_plan_to_model(plan, context)
+        model_plan = dataclasses.replace(plan, remat_policy="full")
+    model = _apply_plan_to_model(model_plan, context)
     if plan.mesh_config.pipeline > 1:
         # route the block stack through the GPipe schedule; the plan's
         # param placement becomes stage-stacked (pipeline axis on the

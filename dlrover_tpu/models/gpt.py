@@ -72,6 +72,19 @@ class GPTConfig:
     def head_dim(self) -> int:
         return self.hidden_dim // self.num_heads
 
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "offload"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} "
+                "(full | offload)"
+            )
+        if self.remat_policy != "full" and not self.remat:
+            raise ValueError(
+                "remat_policy='offload' requires remat=True (the "
+                "policy chooses WHERE checkpoints live; remat "
+                "creates them)"
+            )
+
     @classmethod
     def tiny(cls, **kw) -> "GPTConfig":
         defaults = dict(

@@ -51,6 +51,17 @@ class LlamaConfig:
     moe_every: int = 1
     moe_capacity_factor: float = 1.25
 
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "offload"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} "
+                "(full | offload)"
+            )
+        if self.remat_policy != "full" and not self.remat:
+            raise ValueError(
+                "remat_policy='offload' requires remat=True"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.hidden_dim // self.num_heads

@@ -114,10 +114,14 @@ def group_devices_by_slice(
     have_idx = {
         getattr(d, "slice_index", None) for d in devices
     } - {None}
-    if have_idx and len(have_idx) != num_slices:
+    if len(have_idx) > 1 and len(have_idx) != num_slices:
         # real topology information contradicts the request: a
         # contiguous fallback would let ICI-only axes straddle
-        # physical slice boundaries over DCN — refuse instead
+        # physical slice boundaries over DCN — refuse instead.
+        # (A UNIFORM slice_index carries no multi-slice information
+        # — the cpu runtime reports 0 everywhere, and splitting one
+        # physical slice is only conservative — so it falls through
+        # to the process-ordered contiguous split below.)
         raise ValueError(
             f"devices report {len(have_idx)} physical slices "
             f"({sorted(have_idx)}) but num_slices={num_slices}"

