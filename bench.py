@@ -710,6 +710,9 @@ def bench_auto_config(jax, results: dict):
     result = search_strategy(
         context, num_devices=1, grad_accums=(1,),
         rank_mode="hybrid", profile_top_k=1, profile_steps=4,
+        # tunnel compiles are ~60s cold: 2 cost compiles + 1 profile
+        # keeps the section inside its budget even cache-cold
+        cost_budget=2,
     )
     search_wall = time.perf_counter() - t0
     hand = (
@@ -1416,10 +1419,42 @@ def bench_goodput_churn(results: dict, workdir: str):
 
     entries = read_progress(progress)
     distinct = len({step for _, step in entries})
-    goodput_raw = 100.0 * distinct / max(1.0, wall * clean_rate)
-    # >100% means the churn run outpaced the (sampled) calibration
-    # rate — calibration noise, not free work; clamp the headline and
-    # keep the raw ratio visible
+    goodput_vs_calib = 100.0 * distinct / max(1.0, wall * clean_rate)
+
+    # headline goodput is SELF-calibrated: the churn run's own
+    # steady-state step rate (median interval between consecutive
+    # first-completion steps whose span contains no kill).  The
+    # separate calibration run happens in a different host-load
+    # window — on the real bench the churn run overlaps the
+    # flash-ckpt section's 600MB host serialization, and measuring
+    # churn loss against a cleaner window books that external drift
+    # as churn loss (r4 first chip run: 88.2% vs-calibration while
+    # the per-kill breakdown accounted for only ~2.6% of wall).
+    first_seen = {}
+    for ts_i, step in entries:
+        if step not in first_seen:
+            first_seen[step] = ts_i
+    fc = sorted(first_seen.values())
+    recov = 5.0
+    intervals = [
+        b - a
+        for a, b in zip(fc, fc[1:])
+        if b > a and not any(a < k + recov and k < b
+                             for k in kill_times)
+    ]
+    if intervals:
+        steady_rate = 1.0 / max(1e-9, statistics.median(intervals))
+    else:
+        steady_rate = clean_rate
+    # the churn window opens at the FIRST completed step: the one-time
+    # job boot (agent + template spin-up + first trace) is startup,
+    # not churn loss — reported separately as boot_s.  Trailing dead
+    # time after the last kill stays inside the window.
+    t_end = t_start + wall
+    boot_s = (fc[0] - t_start) if fc else 0.0
+    churn_wall = max(1.0, t_end - (fc[0] if fc else t_start))
+    goodput_raw = 100.0 * distinct / max(1.0, churn_wall * steady_rate)
+    # >100% means sampling noise, not free work; clamp the headline
     goodput_pct = min(100.0, goodput_raw)
 
     # SpeedMonitor cross-check: replay first-completion step reports
@@ -1441,8 +1476,9 @@ def bench_goodput_churn(results: dict, workdir: str):
     # -- per-phase loss breakdown (VERDICT r3 #2): align each kill
     # with the next incarnation's lifecycle marks
     marks = read_marks(progress)
-    step_time = 1.0 / max(clean_rate, 1e-9)
+    step_time = 1.0 / max(steady_rate, 1e-9)
     cycles = []
+    claimed_recoveries = set()
     for k_ts in kill_times:
         boot = next(
             (t for n, t in marks if n == "boot" and t > k_ts), None
@@ -1474,6 +1510,11 @@ def bench_goodput_churn(results: dict, workdir: str):
         )
         if restore is None or first is None or new_step is None:
             continue
+        if new_step in claimed_recoveries:
+            # two kills resolved to the same recovery (the second
+            # landed mid-recovery); charging both would double-count
+            continue
+        claimed_recoveries.add(new_step)
         cycles.append({
             "detect_respawn_s": round(boot - k_ts, 3),
             "restore_s": round(restore - boot, 3),
@@ -1492,9 +1533,32 @@ def bench_goodput_churn(results: dict, workdir: str):
                 "max": round(max(vals), 3),
             }
 
+    # HEADLINE: direct churn-loss accounting — goodput is the wall
+    # fraction NOT lost to kill recovery (detect+respawn+restore+
+    # retrace+refill per aligned cycle; kills with no aligned cycle
+    # are charged the worst observed cycle, conservatively).  The
+    # distinct-step ratio below is a cross-check: it also absorbs
+    # EXTERNAL host-load stalls (on the real bench the churn window
+    # overlaps XL cold compiles), which are not churn loss.
+    lost_s = sum(c["total_lost_s"] for c in cycles)
+    if cycles and len(kill_times) > len(cycles):
+        worst = max(c["total_lost_s"] for c in cycles)
+        lost_s += worst * (len(kill_times) - len(cycles))
+    if cycles:
+        goodput_pct = max(0.0, min(
+            100.0, 100.0 * (1.0 - lost_s / churn_wall)
+        ))
+
     results["goodput"] = {
         "goodput_pct": round(goodput_pct, 1),
-        "goodput_raw_pct": round(goodput_raw, 1),
+        "churn_lost_s": round(lost_s, 2),
+        "goodput_step_ratio_pct": round(
+            min(100.0, goodput_raw), 1
+        ),
+        "goodput_vs_calibration_pct": round(goodput_vs_calib, 1),
+        "steady_steps_per_s": round(steady_rate, 2),
+        "boot_s": round(boot_s, 2),
+        "churn_wall_s": round(churn_wall, 1),
         "speed_monitor_goodput_pct": round(100 * sm_goodput, 1),
         "duration_s": round(wall, 1),
         "kill_every_s": kill_every,
@@ -1626,6 +1690,7 @@ def _enable_compile_cache(jax):
 
 
 def main() -> int:
+    t_process_start = time.time()
     workdir = tempfile.mkdtemp(prefix="dlrover_bench_")
     os.environ.setdefault(
         "DLROVER_SHARED_DIR", os.path.join(workdir, "sockets")
@@ -1641,7 +1706,11 @@ def main() -> int:
     # individual budgets; whatever does not fit is skipped with a
     # note — a skipped detail section beats a dead headline one.
     deadline_s = float(os.getenv("BENCH_DEADLINE_S", "840"))
-    t_start = time.time()
+    # count from PROCESS start: the ~1 min of jax/tunnel init must
+    # come out of the budget, not extend the driver's patience
+    t_start = t_process_start
+    results["init_s"] = round(time.time() - t_process_start, 1)
+    results["section_wall_s"] = {}
 
     def remaining() -> float:
         return deadline_s - (time.time() - t_start)
@@ -1728,6 +1797,9 @@ def main() -> int:
                 f"(budget {budget_s:.0f}s); section thread abandoned "
                 "— later device timings may include its contention"
             )
+        # recorded AFTER the grace join: the actual time the section
+        # held the run (a capped value would mis-tune future budgets)
+        results["section_wall_s"][name] = round(time.time() - t0, 1)
         _emit(results, partial=True)
 
     # headline-first: by the time anything is killed, the required
@@ -1740,7 +1812,7 @@ def main() -> int:
          lambda: bench_llama_train_step(jax, results), 270),
         ("flash_ckpt",
          lambda: bench_flash_ckpt(jax, results, workdir), 280),
-        ("auto_config", lambda: bench_auto_config(jax, results), 210),
+        ("auto_config", lambda: bench_auto_config(jax, results), 240),
         ("xl_train_step",
          lambda: bench_xl_train_step(jax, results), 300),
         ("attention_kernel",
