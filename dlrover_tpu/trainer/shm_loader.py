@@ -219,26 +219,42 @@ class ShmDataLoader:
         self._free_qs = []
         read_blob = pickle.dumps(self._read_fn)
         collate_blob = pickle.dumps(self._collate)
-        for w in range(self._num_workers):
-            shm_name = f"{self._name}_w{w}"
-            self._shms.append(
-                get_or_create_shm(
-                    shm_name, self._slot_bytes * self._slots
+        # workers do numpy-only read/collate/memcpy and must NEVER
+        # initialize the parent's accelerator backend: on a tunneled
+        # remote device an extra client attaching from a spawned
+        # worker can hang the whole link (observed live on the axon
+        # chip).  spawn children snapshot os.environ at start(), so
+        # pin them to cpu for the spawn window.
+        import os as _os
+
+        prev_platforms = _os.environ.get("JAX_PLATFORMS")
+        _os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w in range(self._num_workers):
+                shm_name = f"{self._name}_w{w}"
+                self._shms.append(
+                    get_or_create_shm(
+                        shm_name, self._slot_bytes * self._slots
+                    )
                 )
-            )
-            free_q = self._ctx.Queue()
-            for s in range(self._slots):
-                free_q.put(s)
-            self._free_qs.append(free_q)
-            p = self._ctx.Process(
-                target=_worker_main,
-                args=(w, read_blob, collate_blob, shm_name,
-                      self._slot_bytes, self._slots, self._task_q,
-                      free_q, self._result_q),
-                daemon=True,
-            )
-            p.start()
-            self._procs.append(p)
+                free_q = self._ctx.Queue()
+                for s in range(self._slots):
+                    free_q.put(s)
+                self._free_qs.append(free_q)
+                p = self._ctx.Process(
+                    target=_worker_main,
+                    args=(w, read_blob, collate_blob, shm_name,
+                          self._slot_bytes, self._slots, self._task_q,
+                          free_q, self._result_q),
+                    daemon=True,
+                )
+                p.start()
+                self._procs.append(p)
+        finally:
+            if prev_platforms is None:
+                _os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                _os.environ["JAX_PLATFORMS"] = prev_platforms
         self._probe_batch = probe_batch
         self._started = True
 
