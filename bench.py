@@ -237,6 +237,38 @@ def bench_train_step(jax, results: dict):
     )
 
 
+def _make_xl_step(jax, model, opt):
+    """ONE step recipe shared by every XL leg (bench_xl_train_step
+    and bench_xl_act_offload) — the offload-vs-remat comparison must
+    measure the same step as the headline."""
+    from functools import partial
+
+    import optax
+
+    from dlrover_tpu.models.gpt import cross_entropy_loss
+    from dlrover_tpu.trainer.elastic_trainer import TrainState
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p, t: cross_entropy_loss(
+                model.apply({"params": p}, t[:, :-1]), t[:, 1:]
+            )
+        )(state.params, tokens)
+        updates, new_opt = opt.update(
+            grads, state.opt_state, state.params
+        )
+        return (
+            TrainState(
+                params=optax.apply_updates(state.params, updates),
+                opt_state=new_opt, step=state.step + 1,
+            ),
+            loss,
+        )
+
+    return step
+
+
 def bench_xl_train_step(jax, results: dict):
     """GPT-2-XL (1.56B) on ONE chip — the reference's flash-ckpt
     story model (docs/blogs/megatron_flash_checkpoint.md trains
@@ -267,36 +299,12 @@ def bench_xl_train_step(jax, results: dict):
         max_seq_len=seq, attention_impl="flash", remat=True,
         param_dtype=jnp.bfloat16,
     )
-    def make_step(model, opt):
-        """ONE step recipe for every XL leg — the offload-vs-remat
-        comparison must measure the same step as the headline."""
-
-        @partial(jax.jit, donate_argnums=0)
-        def step(state, tokens):
-            loss, grads = jax.value_and_grad(
-                lambda p, t: cross_entropy_loss(
-                    model.apply({"params": p}, t[:, :-1]), t[:, 1:]
-                )
-            )(state.params, tokens)
-            updates, new_opt = opt.update(
-                grads, state.opt_state, state.params
-            )
-            return (
-                TrainState(
-                    params=optax.apply_updates(state.params, updates),
-                    opt_state=new_opt, step=state.step + 1,
-                ),
-                loss,
-            )
-
-        return step
-
     model = GPT(cfg)
     params = model.init_params(jax.random.PRNGKey(0), seq_len=seq)
     opt = q_adamw(learning_rate=3e-4, weight_decay=0.1)
     state = TrainState.create(params, opt)
     n = count_params(params)
-    step = make_step(model, opt)
+    step = _make_xl_step(jax, model, opt)
 
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(
@@ -357,27 +365,6 @@ def bench_xl_act_offload(jax, results: dict):
     if os.getenv("BENCH_SMOKE"):
         return
 
-    def make_step(model, opt):
-        @partial(jax.jit, donate_argnums=0)
-        def step(state, tokens):
-            loss, grads = jax.value_and_grad(
-                lambda p, t: cross_entropy_loss(
-                    model.apply({"params": p}, t[:, :-1]), t[:, 1:]
-                )
-            )(state.params, tokens)
-            updates, new_opt = opt.update(
-                grads, state.opt_state, state.params
-            )
-            return (
-                TrainState(
-                    params=optax.apply_updates(state.params, updates),
-                    opt_state=new_opt, step=state.step + 1,
-                ),
-                loss,
-            )
-
-        return step
-
     def try_xl(seq2, batch2, policy):
         cfg2 = GPTConfig(
             num_layers=48, num_heads=25, hidden_dim=1600,
@@ -391,7 +378,7 @@ def bench_xl_act_offload(jax, results: dict):
             )
             opt2 = q_adamw(learning_rate=3e-4, weight_decay=0.1)
             state2 = TrainState.create(params2, opt2)
-            step2 = make_step(model2, opt2)
+            step2 = _make_xl_step(jax, model2, opt2)
 
             toks = jnp.asarray(
                 np.random.default_rng(0).integers(
@@ -757,8 +744,26 @@ def bench_auto_config(jax, results: dict):
         cost_budget=2,
     )
     search_wall = time.perf_counter() - t0
+    # the fair comparator runs the HAND recipe through the SAME
+    # profiling harness (per-dispatch timing through the tunnel adds
+    # ~10ms/step the train_step section's scan-of-steps never pays,
+    # which would charge the search for harness overhead)
+    from dlrover_tpu.accel.dry_runner import profile_plan
+    from dlrover_tpu.accel.opt_lib import OptimizationLibrary
+    from dlrover_tpu.accel.strategy import Strategy
+
+    hand_opts = [("parallel_mode", {}), ("amp_native", {})]
+    if jax.default_backend() == "tpu":
+        hand_opts.append(("module_replace", {"attention": "flash"}))
+    hand_plan = OptimizationLibrary().apply_strategy(
+        Strategy(opts=hand_opts), context
+    )
+    hand_prof = profile_plan(
+        hand_plan, context, profile_steps=4
+    )
     hand = (
-        results.get("train_step", {})
+        hand_prof.step_time_s if hand_prof.ok
+        else results.get("train_step", {})
         .get("flash_attention", {})
         .get("step_time_s")
     )
@@ -768,7 +773,15 @@ def bench_auto_config(jax, results: dict):
         "search": "hybrid: cost-model ranks all, top-1 profiled",
         "searched_recipe": result.best.describe(),
         "searched_step_time_s": round(best_t, 4),
-        "hand_recipe_step_time_s": hand,
+        "hand_recipe_step_time_s": (
+            round(hand, 4) if hand else None
+        ),
+        "hand_profiled_same_harness": hand_prof.ok,
+        "train_section_step_time_s": (
+            results.get("train_step", {})
+            .get("flash_attention", {})
+            .get("step_time_s")
+        ),
         "searched_vs_hand": (
             round(best_t / hand, 3) if hand else None
         ),
@@ -1029,13 +1042,13 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     from dlrover_tpu.models.gpt import GPT, GPTConfig, count_params
     from dlrover_tpu.trainer.elastic_trainer import TrainState
 
-    # a 2-layer GPT-2-small slice + adam: ~53M params x3 states
-    # ~0.6 GB fp32 pytree.  Sized deliberately: the remote-device
-    # tunnel moves D2H at ~13 MB/s, so the old 1.5 GB state made this
-    # one section ~7 minutes of pure transfer and starved the rest of
-    # the bench (VERDICT r3 weak #1); the stall-vs-sync RATIO — the
-    # reference's headline (flash_checkpoint.md:361-383) — is
-    # size-independent, and state_mb is reported alongside
+    # a 2-layer 512-wide GPT slice + adam: ~32M params x3 states
+    # ~0.39 GB fp32 pytree.  Sized deliberately: the remote-device
+    # tunnel moves D2H at ~13-34 MB/s, so round 3's 1.5 GB state made
+    # this one section ~7 minutes of pure transfer and starved the
+    # rest of the bench (VERDICT r3 weak #1); the stall-vs-sync
+    # RATIO — the reference's headline (flash_checkpoint.md:361-383)
+    # — is size-independent, and state_mb is reported alongside
     cfg = (
         GPTConfig.tiny()
         if os.getenv("BENCH_SMOKE")
@@ -1856,10 +1869,10 @@ def main() -> int:
     sections = [
         ("train_step", lambda: bench_train_step(jax, results), 200),
         ("llama_train_step",
-         lambda: bench_llama_train_step(jax, results), 270),
+         lambda: bench_llama_train_step(jax, results), 320),
         ("flash_ckpt",
          lambda: bench_flash_ckpt(jax, results, workdir), 240),
-        ("auto_config", lambda: bench_auto_config(jax, results), 240),
+        ("auto_config", lambda: bench_auto_config(jax, results), 260),
         ("attention_kernel",
          lambda: bench_attention_kernel(jax, results), 80),
         ("gqa_attention_kernel",

@@ -84,6 +84,15 @@ def _worker_main(
 ):
     """Worker process: read samples, collate, memcpy into a free shm
     slot, report (batch_id, slot, metas)."""
+    # FIRST, before any import that could initialize a jax backend:
+    # workers do numpy-only read/collate/memcpy and must never attach
+    # to the parent's accelerator — on a tunneled remote device an
+    # extra client from a spawned worker can hang the whole link
+    # (observed live on the axon chip).  jax reads JAX_PLATFORMS at
+    # backend init, which nothing in this child has triggered yet.
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
     from dlrover_tpu.common.multi_process import get_or_create_shm
 
     read_fn = pickle.loads(read_fn_blob)
@@ -219,42 +228,26 @@ class ShmDataLoader:
         self._free_qs = []
         read_blob = pickle.dumps(self._read_fn)
         collate_blob = pickle.dumps(self._collate)
-        # workers do numpy-only read/collate/memcpy and must NEVER
-        # initialize the parent's accelerator backend: on a tunneled
-        # remote device an extra client attaching from a spawned
-        # worker can hang the whole link (observed live on the axon
-        # chip).  spawn children snapshot os.environ at start(), so
-        # pin them to cpu for the spawn window.
-        import os as _os
-
-        prev_platforms = _os.environ.get("JAX_PLATFORMS")
-        _os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            for w in range(self._num_workers):
-                shm_name = f"{self._name}_w{w}"
-                self._shms.append(
-                    get_or_create_shm(
-                        shm_name, self._slot_bytes * self._slots
-                    )
+        for w in range(self._num_workers):
+            shm_name = f"{self._name}_w{w}"
+            self._shms.append(
+                get_or_create_shm(
+                    shm_name, self._slot_bytes * self._slots
                 )
-                free_q = self._ctx.Queue()
-                for s in range(self._slots):
-                    free_q.put(s)
-                self._free_qs.append(free_q)
-                p = self._ctx.Process(
-                    target=_worker_main,
-                    args=(w, read_blob, collate_blob, shm_name,
-                          self._slot_bytes, self._slots, self._task_q,
-                          free_q, self._result_q),
-                    daemon=True,
-                )
-                p.start()
-                self._procs.append(p)
-        finally:
-            if prev_platforms is None:
-                _os.environ.pop("JAX_PLATFORMS", None)
-            else:
-                _os.environ["JAX_PLATFORMS"] = prev_platforms
+            )
+            free_q = self._ctx.Queue()
+            for s in range(self._slots):
+                free_q.put(s)
+            self._free_qs.append(free_q)
+            p = self._ctx.Process(
+                target=_worker_main,
+                args=(w, read_blob, collate_blob, shm_name,
+                      self._slot_bytes, self._slots, self._task_q,
+                      free_q, self._result_q),
+                daemon=True,
+            )
+            p.start()
+            self._procs.append(p)
         self._probe_batch = probe_batch
         self._started = True
 
