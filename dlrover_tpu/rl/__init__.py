@@ -10,10 +10,20 @@ from dlrover_tpu.rl.ppo import (
     ppo_critic_loss,
     ppo_policy_loss,
 )
+from dlrover_tpu.rl.trainer import (
+    PPOTrainer,
+    ReplayBuffer,
+    RLTrainConfig,
+    RLTrainer,
+)
 
 __all__ = [
     "HybridRolloutEngine",
     "ModelRole",
+    "PPOTrainer",
+    "ReplayBuffer",
+    "RLTrainConfig",
+    "RLTrainer",
     "RLModelEngine",
     "gae_advantages",
     "ppo_critic_loss",

@@ -76,7 +76,7 @@ def sample_rollout_batch(prompts, max_new_tokens: int) -> Dict:
     }
 
 
-def ppo_iteration(
+def make_experience(
     engine: RLModelEngine,
     prompts: jax.Array,
     rng: jax.Array,
@@ -87,21 +87,31 @@ def ppo_iteration(
     lam: float = 0.95,
     reward_fn: Callable = None,
     hybrid=None,
-) -> Dict[str, float]:
-    """One full PPO iteration: rollout -> score -> GAE -> two PPO
-    steps.  ``reward_fn(sequences) -> [b]`` overrides the reward role
-    (otherwise the reward model scores the final token).
+    rollout_params=None,
+):
+    """EXPERIENCE phase of one PPO cycle (reference:
+    RLTrainer.make_experience): rollout -> ref-KL scoring -> reward
+    -> GAE, producing the training batch WITHOUT taking a gradient
+    step — so a trainer can fill a replay buffer with several
+    rollouts before the training phase (the reference's
+    num_rollouts contract).
 
-    ``hybrid`` (a :class:`dlrover_tpu.rl.hybrid_engine.
-    HybridRolloutEngine`) swaps the actor into its rollout layout for
-    generation — train and rollout may use different meshes; the
-    timed reshard latency lands in the returned metrics.
-    Returns metrics including the mean sequence reward."""
+    ``hybrid`` swaps the actor into its rollout layout for
+    generation.  ``rollout_params`` (already-resharded actor params,
+    e.g. from a phase hook) skips the per-call reshard — the actor
+    does not train inside an experience phase, so one swap serves
+    every rollout of the phase.  Returns (batch dict, metrics)."""
     b, prompt_len = prompts.shape
     actor = engine._roles[ModelRole.ACTOR].model
     actor_decode = decode_variant(actor)
-    if hybrid is not None:
+    fresh_reshard = False
+    if rollout_params is not None:
+        actor_params = rollout_params
+        if hybrid is not None:
+            prompts = hybrid.place_rollout_batch(prompts)
+    elif hybrid is not None:
         actor_params = hybrid.reshard_actor_for_rollout()
+        fresh_reshard = True
         prompts = hybrid.place_rollout_batch(prompts)
     else:
         actor_params = engine.state(ModelRole.ACTOR).params
@@ -145,6 +155,21 @@ def ppo_iteration(
         "advantages": advantages,
         "returns": returns,
     }
+    metrics = {
+        "mean_reward": float(seq_reward.mean()),
+        "mean_kl": float(kl.mean()),
+    }
+    if fresh_reshard:
+        metrics["reshard_s"] = hybrid.reshard_times[-1]
+    return batch, metrics
+
+
+def train_on_batch(
+    engine: RLModelEngine, batch: Dict
+) -> Dict[str, float]:
+    """TRAINING phase: one actor + one critic PPO step on an
+    experience batch (reference: RLTrainer.rl_training inner
+    update)."""
     losses = {}
     for role in (ModelRole.ACTOR, ModelRole.CRITIC):
         placed = engine.place_batch(role, batch)
@@ -153,12 +178,29 @@ def ppo_iteration(
         )
         engine.set_state(role, state)
         losses[f"{role}_loss"] = float(metrics["loss"])
+    return losses
 
-    metrics = {
-        "mean_reward": float(seq_reward.mean()),
-        "mean_kl": float(kl.mean()),
-        **losses,
-    }
-    if hybrid is not None:
-        metrics["reshard_s"] = hybrid.reshard_times[-1]
+
+def ppo_iteration(
+    engine: RLModelEngine,
+    prompts: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int = 16,
+    temperature: float = 1.0,
+    kl_coef: float = 0.05,
+    gamma: float = 1.0,
+    lam: float = 0.95,
+    reward_fn: Callable = None,
+    hybrid=None,
+) -> Dict[str, float]:
+    """One full PPO iteration: make_experience + train_on_batch.
+    ``reward_fn(sequences) -> [b]`` overrides the reward role
+    (otherwise the reward model scores the final token).
+    Returns metrics including the mean sequence reward."""
+    batch, metrics = make_experience(
+        engine, prompts, rng, max_new_tokens=max_new_tokens,
+        temperature=temperature, kl_coef=kl_coef, gamma=gamma,
+        lam=lam, reward_fn=reward_fn, hybrid=hybrid,
+    )
+    metrics.update(train_on_batch(engine, batch))
     return metrics

@@ -338,3 +338,178 @@ def test_ppo_hybrid_rollout_resharding_improves_reward():
     assert any(
         "tensor" in str(s.spec) for s in specs
     ), [str(s.spec) for s in specs[:5]]
+
+
+def test_replay_buffer_minibatches():
+    from dlrover_tpu.rl.trainer import ReplayBuffer
+
+    buf = ReplayBuffer()
+    for i in range(3):
+        buf.add({"a": np.full((4, 2), i), "b": np.arange(4) + 10 * i})
+    assert buf.num == 12
+    rng = np.random.default_rng(0)
+    mbs = list(buf.minibatches(5, rng))
+    assert len(mbs) == 2  # 12 // 5, remainder dropped
+    seen = np.concatenate([mb["b"] for mb in mbs])
+    assert len(set(seen.tolist())) == 10  # no duplicates
+    buf.reset()
+    assert buf.num == 0 and not list(buf.minibatches(2, rng))
+    with pytest.raises(ValueError, match="ragged"):
+        buf.add({"a": np.zeros((4, 2)), "b": np.zeros(3)})
+
+
+def test_rl_train_config_yaml(tmp_path):
+    from dlrover_tpu.rl.trainer import RLTrainConfig
+
+    p = tmp_path / "rl.yaml"
+    p.write_text(
+        "epochs: 2\nnum_rollouts: 16\nppo_epochs: 3\n"
+        "train_batch_size: 4\nkl_coef: 0.01\nlogdir: /tmp/x\n"
+    )
+    cfg = RLTrainConfig.from_yaml(str(p))
+    assert cfg.epochs == 2 and cfg.num_rollouts == 16
+    assert cfg.ppo_epochs == 3 and cfg.kl_coef == 0.01
+    assert cfg.extra == {"logdir": "/tmp/x"}
+
+
+def test_ppo_trainer_buffer_cycle_improves_reward():
+    """The reference trainer shape: fill the replay buffer with
+    several rollouts, then PPO epochs over shuffled minibatches —
+    reward improves across cycles and the buffer resets per phase."""
+    import optax as _optax
+
+    from dlrover_tpu.accel import Strategy
+    from dlrover_tpu.rl.rollout import (
+        make_actor_loss,
+        make_critic_loss,
+        sample_rollout_batch,
+    )
+    from dlrover_tpu.rl.trainer import PPOTrainer, RLTrainConfig
+
+    cfg = GPTConfig.tiny(max_seq_len=64, vocab_size=32)
+    actor_model = GPT(cfg)
+    critic_model = GPT(
+        GPTConfig.tiny(max_seq_len=64, vocab_size=32, head="value")
+    )
+    ref_model = GPT(cfg)
+
+    prompt_len, max_new = 4, 8
+    rng_np = np.random.default_rng(0)
+    prompts = [
+        jnp.asarray(rng_np.integers(
+            0, cfg.vocab_size, (8, prompt_len), dtype=np.int32
+        ))
+        for _ in range(4)
+    ]
+    sample = sample_rollout_batch(prompts[0], max_new)
+    dp = Strategy(opts=[("parallel_mode", {})])
+    actor_params = actor_model.init_params(jax.random.PRNGKey(1))
+    engine = RLModelEngine(sample, {
+        ModelRole.ACTOR: RoleSpec(
+            model=actor_model,
+            loss_fn=make_actor_loss(actor_model, prompt_len),
+            optim_factory=lambda: _optax.adam(5e-3),
+            strategy=dp,
+        ),
+        ModelRole.CRITIC: RoleSpec(
+            model=critic_model,
+            loss_fn=make_critic_loss(critic_model, prompt_len),
+            optim_factory=lambda: _optax.adam(1e-3),
+            strategy=dp,
+        ),
+        ModelRole.REF: RoleSpec(model=ref_model, params=actor_params),
+    }).build()
+
+    def reward_fn(sequences):
+        resp = sequences[:, prompt_len:]
+        return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+    trainer = PPOTrainer(
+        engine,
+        RLTrainConfig(
+            epochs=4, num_rollouts=16, ppo_epochs=2,
+            train_batch_size=8, max_new_tokens=max_new,
+            kl_coef=0.01,
+        ),
+        reward_fn=reward_fn,
+    )
+    history = trainer.train(prompts)
+    # 4 prompt batches x 8 = 32 rollouts per epoch -> 2 training
+    # phases per epoch x 4 epochs
+    assert len(history) >= 6, history
+    assert all(h["ppo_steps"] > 0 for h in history)
+    rewards = [h["mean_reward"] for h in history if "mean_reward" in h]
+    assert np.mean(rewards[-2:]) > np.mean(rewards[:2]), rewards
+    # buffer reset between phases
+    assert trainer.replay_buffer.num == 0
+
+
+def test_ppo_trainer_hybrid_reshards_once_per_phase():
+    """The phase hook amortizes the layout swap: one reshard per
+    experience phase, reused by every rollout in it."""
+    import optax as _optax
+    from jax.sharding import Mesh
+
+    from dlrover_tpu.accel import Strategy
+    from dlrover_tpu.rl.hybrid_engine import HybridRolloutEngine
+    from dlrover_tpu.rl.rollout import (
+        make_actor_loss,
+        make_critic_loss,
+        sample_rollout_batch,
+    )
+    from dlrover_tpu.rl.trainer import PPOTrainer, RLTrainConfig
+
+    cfg = GPTConfig.tiny(max_seq_len=64, vocab_size=32)
+    actor_model = GPT(cfg)
+    critic_model = GPT(
+        GPTConfig.tiny(max_seq_len=64, vocab_size=32, head="value")
+    )
+    prompt_len, max_new = 4, 8
+    rng_np = np.random.default_rng(0)
+    prompts = [
+        jnp.asarray(rng_np.integers(
+            0, cfg.vocab_size, (8, prompt_len), dtype=np.int32
+        ))
+        for _ in range(3)
+    ]
+    sample = sample_rollout_batch(prompts[0], max_new)
+    actor_params = actor_model.init_params(jax.random.PRNGKey(1))
+    engine = RLModelEngine(sample, {
+        ModelRole.ACTOR: RoleSpec(
+            model=actor_model,
+            loss_fn=make_actor_loss(actor_model, prompt_len),
+            optim_factory=lambda: _optax.adam(5e-3),
+            strategy=Strategy(opts=[("fsdp", {})]),
+        ),
+        ModelRole.CRITIC: RoleSpec(
+            model=critic_model,
+            loss_fn=make_critic_loss(critic_model, prompt_len),
+            optim_factory=lambda: _optax.adam(1e-3),
+            strategy=Strategy(opts=[("parallel_mode", {})]),
+        ),
+        ModelRole.REF: RoleSpec(
+            model=GPT(cfg), params=actor_params
+        ),
+    }).build()
+    hybrid = HybridRolloutEngine(
+        engine,
+        Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+             ("data", "tensor")),
+    )
+    trainer = PPOTrainer(
+        engine,
+        RLTrainConfig(
+            epochs=2, num_rollouts=24, ppo_epochs=1,
+            train_batch_size=8, max_new_tokens=max_new,
+        ),
+        reward_fn=lambda s: (s[:, prompt_len:] < 16).mean(
+            axis=1
+        ).astype(jnp.float32),
+        hybrid=hybrid,
+    )
+    history = trainer.train(prompts)
+    # 3 batches x 8 = 24 rollouts/epoch -> exactly 1 training phase
+    # per epoch -> exactly 1 reshard per phase, 2 total
+    assert len(history) == 2
+    assert hybrid.stats()["reshards"] == 2, hybrid.stats()
+    assert trainer._rollout_params is None
