@@ -60,14 +60,35 @@ def main():
         resp = sequences[:, PROMPT_LEN:]
         return (resp < 16).mean(axis=1).astype(jnp.float32)
 
+    # tier 1: hand-rolled iterations (ppo_iteration = one
+    # experience + one PPO step — the quick-start shape)
     rng = jax.random.PRNGKey(2)
-    for it in range(20):
+    for it in range(5):
         rng, sub = jax.random.split(rng)
         metrics = ppo_iteration(
             engine, prompts, sub, max_new_tokens=MAX_NEW,
             kl_coef=0.02, reward_fn=reward_fn,
         )
         print(f"iter {it}: {metrics}")
+
+    # tier 2: the trainer loop (reference shape) — fill a replay
+    # buffer with rollouts, then PPO epochs over shuffled
+    # minibatches; add hybrid=HybridRolloutEngine(engine, mesh) to
+    # generate on a different (tensor-parallel) layout
+    from dlrover_tpu.rl.trainer import PPOTrainer, RLTrainConfig
+
+    trainer = PPOTrainer(
+        engine,
+        RLTrainConfig(
+            epochs=4, num_rollouts=32, ppo_epochs=2,
+            train_batch_size=16, max_new_tokens=MAX_NEW,
+            kl_coef=0.02,
+        ),
+        reward_fn=reward_fn,
+    )
+    history = trainer.train([prompts, prompts])
+    for h in history:
+        print(h)
 
 
 if __name__ == "__main__":
