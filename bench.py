@@ -1369,7 +1369,7 @@ def bench_goodput_churn(results: dict, workdir: str):
             [
                 sys.executable, "-m", "dlrover_tpu.run",
                 "--nproc_per_node=1", "--max_restarts=100",
-                "--monitor_interval=0.3", "--warm-restart",
+                "--monitor_interval=0.2", "--warm-restart",
                 script, ckpt_dir, progress,
             ],
             env=env, cwd=os.getcwd(), stdout=subprocess.DEVNULL,
@@ -1906,7 +1906,12 @@ def main() -> int:
     shutil.rmtree(workdir, ignore_errors=True)
     done_evt.set()
     _emit(results)
-    return 0
+    # hard exit: abandoned section threads may hold in-flight tunnel
+    # work whose C++ teardown aborts the interpreter AFTER the final
+    # line (observed: SIGABRT "exception not rethrown" post-emission
+    # turning a complete run into rc=134); the JSON is already out
+    sys.stdout.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":
