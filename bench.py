@@ -1597,8 +1597,16 @@ def bench_goodput_churn(results: dict, workdir: str):
     # overlaps XL cold compiles), which are not churn loss.
     lost_s = sum(c["total_lost_s"] for c in cycles)
     if cycles and len(kill_times) > len(cycles):
+        # kills with no aligned cycle are usually the last ones,
+        # their recovery truncated by the window end: charge the
+        # smaller of the worst observed cycle and the time the kill
+        # could actually have cost inside the window (kills align to
+        # cycles in order, so the unaligned ones are the tail)
         worst = max(c["total_lost_s"] for c in cycles)
-        lost_s += worst * (len(kill_times) - len(cycles))
+        lost_s += sum(
+            min(worst, max(0.0, t_end - k))
+            for k in kill_times[len(cycles):]
+        )
     if cycles:
         goodput_pct = max(0.0, min(
             100.0, 100.0 * (1.0 - lost_s / churn_wall)
@@ -1871,7 +1879,7 @@ def main() -> int:
         ("llama_train_step",
          lambda: bench_llama_train_step(jax, results), 320),
         ("flash_ckpt",
-         lambda: bench_flash_ckpt(jax, results, workdir), 240),
+         lambda: bench_flash_ckpt(jax, results, workdir), 320),
         ("auto_config", lambda: bench_auto_config(jax, results), 260),
         ("attention_kernel",
          lambda: bench_attention_kernel(jax, results), 80),
