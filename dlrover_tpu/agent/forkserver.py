@@ -230,6 +230,7 @@ class WorkerForkServer:
         self._exits: Dict[int, int] = {}
         self._spawned: List[int] = []
         self._spawn_results: Dict[int, int] = {}  # req id -> pid
+        self._abandoned: set = set()  # req ids whose caller timed out
         self._next_req = 0
         self._lock = threading.Lock()
         # spawn requests are serialized: the pipe is a shared stream
@@ -263,6 +264,18 @@ class WorkerForkServer:
                     continue
                 with self._lock:
                     if msg["event"] == "spawned":
+                        if msg.get("req", -1) in self._abandoned:
+                            # the caller timed out waiting for this
+                            # spawn: nobody will ever own the pid, so
+                            # reap it here instead of leaking an
+                            # unmanaged worker + a dict entry forever
+                            self._abandoned.discard(msg.get("req", -1))
+                            try:
+                                os.kill(msg["pid"], signal.SIGKILL)
+                            except (ProcessLookupError,
+                                    PermissionError):
+                                pass
+                            continue
                         self._spawned.append(msg["pid"])
                         self._spawn_results[msg.get("req", -1)] = (
                             msg["pid"]
@@ -297,6 +310,16 @@ class WorkerForkServer:
             if pid is not None:
                 return ForkedWorkerHandle(pid, self)
             time.sleep(0.01)
+        with self._lock:
+            # the template may still complete this spawn after the
+            # timeout: mark the req id abandoned so the reader thread
+            # kills the late-arriving pid instead of leaking it (and
+            # its _spawn_results entry) forever
+            late = self._spawn_results.pop(req_id, None)
+            if late is None:
+                self._abandoned.add(req_id)
+        if late is not None:  # landed between the last poll and now
+            return ForkedWorkerHandle(late, self)
         raise RuntimeError("fork server did not spawn a worker in time")
 
     def exit_code(self, pid: int) -> Optional[int]:

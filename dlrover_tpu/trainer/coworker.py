@@ -79,6 +79,14 @@ class CoworkerDataService(RequestHandler):
         self._next_id = 0
         self._id_lock = threading.Lock()
         self._stop = threading.Event()
+        # the server socket exists before start(): a next_batch
+        # arriving in that window must wait, not see end-of-data
+        self._started = threading.Event()
+        # one failed batch build poisons the service for EVERY
+        # consumer: a single queued ('error',) item would reach one
+        # consumer while the rest saw a clean end and silently lost
+        # the failed batch's samples
+        self._error: Optional[str] = None
         self._served = 0
         self._build_s = 0.0
         self._workers = [
@@ -100,6 +108,7 @@ class CoworkerDataService(RequestHandler):
         self._server.start()
         for w in self._workers:
             w.start()
+        self._started.set()
         return self
 
     def stop(self):
@@ -140,7 +149,7 @@ class CoworkerDataService(RequestHandler):
                 )
             except Exception as e:  # noqa: BLE001 - ship to trainer
                 logger.error("coworker batch build failed: %s", e)
-                self._put(("error", repr(e)))
+                self._error = repr(e)
                 return
             self._build_s += time.perf_counter() - t0
             with self._id_lock:
@@ -167,18 +176,30 @@ class CoworkerDataService(RequestHandler):
         if message != "next_batch":
             raise ValueError(f"unknown coworker request {message!r}")
         while True:
+            if self._error is not None:
+                return ("error", self._error)
+            if self._stop.is_set():
+                # stop() without (or before) start(): release any
+                # waiting consumer instead of polling forever
+                return ("end",)
             try:
                 # short poll: the END answer must not cost a long
                 # timeout cycle (it lands in the consumer's
                 # input-wait accounting)
                 item = self._ready.get(timeout=0.05)
             except queue.Empty:
+                if not self._started.is_set():
+                    # start() has not run yet: the workers exist but
+                    # none has started — is_alive() would read as
+                    # end-of-data
+                    continue
                 # end-of-data only when no builder can still
                 # produce a batch (builders exit only after draining
                 # the index iterator; one may still hold an in-flight
                 # batch, so every builder thread must be gone)
                 alive = any(w.is_alive() for w in self._workers)
-                if not alive and self._ready.empty():
+                if (not alive and self._ready.empty()
+                        and self._error is None):
                     return ("end",)
                 continue
             self._served += 1 if item[0] == "batch" else 0

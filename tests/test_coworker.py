@@ -5,6 +5,7 @@ them over the comm layer into trainer-side loaders."""
 
 import os
 import subprocess
+import threading
 import sys
 import time
 
@@ -147,5 +148,53 @@ def test_coworker_service_error_surfaces():
         loader = CoworkerDataLoader(f"127.0.0.1:{svc.port}")
         with pytest.raises(RuntimeError, match="disk on fire"):
             list(loader)
+    finally:
+        svc.stop()
+
+
+def test_coworker_request_before_start_waits_for_batches():
+    """A next_batch landing before start() (the socket exists from
+    __init__) must wait for the workers, not answer end-of-data."""
+    svc = CoworkerDataService(
+        read_fn=lambda i: np.full(4, i, np.float32),
+        batch_size=2, index_iter=range(4), host="127.0.0.1",
+    )
+    got = {}
+
+    def early_request():
+        got["item"] = svc.get(0, "consumer", "next_batch")
+
+    t = threading.Thread(target=early_request, daemon=True)
+    t.start()
+    time.sleep(0.3)  # the request is in flight against an un-started service
+    assert "item" not in got
+    svc.start()
+    try:
+        t.join(timeout=10)
+        assert got["item"][0] == "batch", got["item"]
+    finally:
+        svc.stop()
+
+
+def test_coworker_error_latched_for_every_consumer():
+    """One failed batch build poisons the stream for ALL consumers —
+    no consumer may see a clean end and silently lose samples."""
+    def bad_read(i):
+        raise IOError("disk on fire")
+
+    svc = CoworkerDataService(
+        read_fn=bad_read, batch_size=2, index_iter=range(8),
+        num_workers=2, host="127.0.0.1",
+    ).start()
+    try:
+        deadline = time.time() + 10
+        answers = []
+        while len(answers) < 3 and time.time() < deadline:
+            item = svc.get(len(answers), "consumer", "next_batch")
+            if item[0] == "error":
+                answers.append(item)
+        assert len(answers) == 3
+        for item in answers:
+            assert "disk on fire" in item[1]
     finally:
         svc.stop()
