@@ -15,7 +15,7 @@ from dlrover_tpu.optim.local_sgd import (
     init_diloco,
     reduce_deltas,
 )
-from dlrover_tpu.optim.low_bit import q_adamw
+from dlrover_tpu.optim.low_bit import q_adamw, q_agd
 from dlrover_tpu.optim.offload import adamw_offload, offload
 from dlrover_tpu.optim.wsam import sam_gradient, wsam
 
@@ -30,6 +30,7 @@ __all__ = [
     "offload",
     "q_adafactor",
     "q_adamw",
+    "q_agd",
     "q_came",
     "sam_gradient",
     "wsam",

@@ -3,14 +3,16 @@ difference (Yue et al., NeurIPS 2023).
 
 Reference integration point: ``atorch/optimizers/agd.py:18`` (torch).
 Algorithm (from the paper, reimplemented functionally): the second
-moment accumulates the squared *difference* of successive gradients —
-an approximation of curvature — and the preconditioner
-``max(sqrt(v_hat), delta)`` auto-switches between adaptive behaviour
-(where curvature is informative) and SGD-like steps (where it is
-below ``delta``).
+moment accumulates the squared difference of successive
+*bias-corrected first moments* — ``m̂_t − m̂_{t−1}`` is the paper's
+curvature proxy (the reference computes it from ``exp_avg`` before
+and after the in-place update, so no extra gradient buffer is
+stored) — and the preconditioner ``max(sqrt(v), delta·sqrt(bc2))``
+auto-switches between adaptive behaviour (where curvature is
+informative) and SGD-like steps (where it is below ``delta``).
 """
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -20,8 +22,7 @@ import optax
 class AGDState(NamedTuple):
     count: jax.Array
     mu: optax.Updates       # first moment
-    nu: optax.Updates       # second moment of gradient differences
-    prev_grad: optax.Updates
+    nu: optax.Updates       # second moment of m̂ differences
 
 
 def agd(
@@ -33,37 +34,45 @@ def agd(
     weight_decay: float = 0.0,
 ) -> optax.GradientTransformation:
     def init_fn(params):
-        zeros = jax.tree.map(jnp.zeros_like, params)
         return AGDState(
             count=jnp.zeros((), jnp.int32),
             mu=jax.tree.map(jnp.zeros_like, params),
             nu=jax.tree.map(jnp.zeros_like, params),
-            prev_grad=zeros,
         )
 
     def update_fn(grads, state, params=None):
         count = state.count + 1
-        # first step: difference vs zero would overestimate; use g
-        diff = jax.tree.map(
-            lambda g, pg: jnp.where(count == 1, g, g - pg),
-            grads, state.prev_grad,
-        )
+        cf = count.astype(jnp.float32)
+        bc1 = 1 - b1**cf
+        bc2 = 1 - b2**cf
+        # zero at step 1 (m̂_0 does not exist); clamped because
+        # jnp.where evaluates both branches
+        bc1_old = jnp.maximum(1 - b1 ** (cf - 1), 1e-30)
+
         mu = jax.tree.map(
             lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads
+        )
+        # curvature proxy: difference of bias-corrected first
+        # moments; step 1 uses m̂_1 itself (= g_1)
+        diff = jax.tree.map(
+            lambda m_new, m_old: jnp.where(
+                count == 1,
+                m_new / bc1,
+                m_new / bc1 - m_old / bc1_old,
+            ),
+            mu, state.mu,
         )
         nu = jax.tree.map(
             lambda v, d: b2 * v + (1 - b2) * d * d, state.nu, diff
         )
-        bc1 = 1 - b1**count.astype(jnp.float32)
-        bc2 = 1 - b2**count.astype(jnp.float32)
 
         def direction(m, v):
-            m_hat = m / bc1
-            v_hat = jnp.sqrt(v / bc2)
-            # auto-switch: adaptive where sqrt(v_hat) > delta,
-            # SGD-like (divide by delta) elsewhere
-            denom = jnp.maximum(v_hat, delta) + eps
-            return m_hat / denom
+            # auto-switch: adaptive where sqrt(v) > delta·sqrt(bc2),
+            # SGD-like (divide by delta·sqrt(bc2)) elsewhere
+            denom = jnp.maximum(
+                jnp.sqrt(v), delta * jnp.sqrt(bc2)
+            ) + eps
+            return (jnp.sqrt(bc2) / bc1) * m / denom
 
         updates = jax.tree.map(direction, mu, nu)
         if weight_decay:
@@ -74,8 +83,6 @@ def agd(
         updates = jax.tree.map(
             lambda u: -learning_rate * u, updates
         )
-        return updates, AGDState(
-            count=count, mu=mu, nu=nu, prev_grad=grads
-        )
+        return updates, AGDState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)

@@ -256,6 +256,145 @@ def _q_adamw_4bit(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class QAGDState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates   # pytree of QMoment (signed linear)
+    nu: optax.Updates   # pytree of QMoment (sqrt-domain)
+
+
+def q_agd(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    delta: float = 1e-5,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    block_size: int = DEFAULT_BLOCK,
+    bits: int = 8,
+) -> optax.GradientTransformation:
+    """AGD (:func:`dlrover_tpu.optim.agd.agd`, same math) with int8 or
+    int4 blockwise moment storage — the low-bit variant of the
+    reference's own optimizer (``atorch/optimizers/low_bit/optim/
+    q_agd.py:1``), 4x (8x) less optimizer HBM than fp32 AGD.
+
+    mu is stored signed-linear; nu is stored in the SQRT domain
+    (resolution goes where the preconditioner reads it, matching the
+    q_adamw convention).  Dequant -> fp32 AGD math -> requant; XLA
+    fuses the elementwise chain.  ``learning_rate`` may be an optax
+    schedule callable of the 0-based step count."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if bits == 8:
+        def qmu(x):
+            return _quant(x, block_size)
+
+        def dqmu(qm, shape):
+            return _dequant(qm, shape)
+
+        def qnu(x):
+            # sqrt-domain via the linear int8 codec on sqrt(v)
+            return _quant(
+                jnp.sqrt(jnp.maximum(x, 0.0)), block_size
+            )
+
+        def dqnu(qm, shape):
+            y = _dequant(qm, shape)
+            return y * y
+    else:
+        from dlrover_tpu.ops.quantization import (
+            dequantize_blockwise_4bit,
+            dequantize_blockwise_4bit_sqrt,
+            quantize_blockwise_4bit,
+            quantize_blockwise_4bit_sqrt,
+        )
+
+        def qmu(x):
+            packed, scales, _ = quantize_blockwise_4bit(
+                x, block_size
+            )
+            return QMoment(values=packed, scales=scales)
+
+        def dqmu(qm, shape):
+            return dequantize_blockwise_4bit(
+                qm.values, qm.scales, shape
+            )
+
+        def qnu(x):
+            packed, scales, _ = quantize_blockwise_4bit_sqrt(
+                x, block_size
+            )
+            return QMoment(values=packed, scales=scales)
+
+        def dqnu(qm, shape):
+            return dequantize_blockwise_4bit_sqrt(
+                qm.values, qm.scales, shape
+            )
+
+    def init_fn(params):
+        return QAGDState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(
+                lambda p: qmu(jnp.zeros_like(p, jnp.float32)),
+                params,
+            ),
+            nu=jax.tree.map(
+                lambda p: qnu(jnp.zeros_like(p, jnp.float32)),
+                params,
+            ),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("q_agd requires params")
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        bc1 = 1 - b1**cf
+        bc2 = 1 - b2**cf
+        bc1_old = jnp.maximum(1 - b1 ** (cf - 1), 1e-30)
+        lr_t = (
+            jnp.asarray(learning_rate(state.count), jnp.float32)
+            if callable(learning_rate) else learning_rate
+        )
+
+        def leaf_update(g, qm, qn, p):
+            g = g.astype(jnp.float32)
+            m_old = dqmu(qm, g.shape)
+            m_new = b1 * m_old + (1 - b1) * g
+            diff = jnp.where(
+                count == 1,
+                m_new / bc1,
+                m_new / bc1 - m_old / bc1_old,
+            )
+            v_new = b2 * dqnu(qn, g.shape) + (1 - b2) * diff * diff
+            denom = jnp.maximum(
+                jnp.sqrt(v_new), delta * jnp.sqrt(bc2)
+            ) + eps
+            upd = -lr_t * (
+                (jnp.sqrt(bc2) / bc1) * m_new / denom
+                + weight_decay * p.astype(jnp.float32)
+            )
+            return upd.astype(p.dtype), qmu(m_new), qnu(v_new)
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        flat_p = treedef.flatten_up_to(params)
+        out = [
+            leaf_update(g, m, n, p)
+            for g, m, n, p in zip(flat_g, flat_mu, flat_nu, flat_p)
+        ]
+        return (
+            treedef.unflatten([o[0] for o in out]),
+            QAGDState(
+                count=count,
+                mu=treedef.unflatten([o[1] for o in out]),
+                nu=treedef.unflatten([o[2] for o in out]),
+            ),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def migrate_qadamw_state_v0(old_state, block_size: int = DEFAULT_BLOCK):
     """Upgrade a pre-``nu_domain`` 8-bit QAdamWState (nu stored
     LINEAR: ``value = q * scale``) to the current sqrt-domain format.

@@ -736,3 +736,46 @@ def test_diloco_outer_step_reduce_method_knob():
     err_lin = np.linalg.norm(outs["linear"] - target)
     err_gta = np.linalg.norm(outs["gta"] - target)
     assert err_gta < err_lin, (err_gta, err_lin)
+
+
+def test_q_agd_parity_with_fp32_agd():
+    """q_agd (int8 moments) tracks fp32 AGD on a quadratic: same
+    math, only blockwise-quantized state (reference capability:
+    atorch/optimizers/low_bit/optim/q_agd.py:1)."""
+    from dlrover_tpu.optim import q_agd
+
+    params, loss, target = _quadratic()
+    f32 = _run_steps(agd(learning_rate=0.1), dict(params), loss)
+    q8 = _run_steps(q_agd(learning_rate=0.1), dict(params), loss)
+    np.testing.assert_allclose(
+        np.asarray(q8["w"]), np.asarray(target), atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(q8["w"]), np.asarray(f32["w"]), atol=0.02
+    )
+
+
+def test_q_agd_4bit_converges():
+    from dlrover_tpu.optim import q_agd
+
+    params, loss, target = _quadratic()
+    final = _run_steps(
+        q_agd(learning_rate=0.1, bits=4), dict(params), loss
+    )
+    np.testing.assert_allclose(
+        np.asarray(final["w"]), np.asarray(target), atol=0.08
+    )
+
+
+def test_q_agd_state_is_int8():
+    from dlrover_tpu.optim import q_agd
+    from dlrover_tpu.optim.low_bit import QMoment
+
+    params, loss, _ = _quadratic()
+    opt = q_agd(learning_rate=0.1)
+    state = opt.init(params)
+    g = jax.grad(loss)(params)
+    _, s1 = opt.update(g, state, params)
+    assert isinstance(s1.mu["w"], QMoment)
+    assert s1.mu["w"].values.dtype == jnp.int8
+    assert s1.nu["w"].values.dtype == jnp.int8
