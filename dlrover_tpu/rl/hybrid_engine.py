@@ -77,6 +77,9 @@ class HybridRolloutEngine:
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         self.reshard_times.append(dt)
+        # the engine's per-role accounting sees every layout
+        # transition, including this external one
+        self._engine.record_reshard(ModelRole.ACTOR, dt)
         logger.debug("actor train->rollout reshard: %.4fs", dt)
         return out
 

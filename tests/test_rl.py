@@ -513,3 +513,110 @@ def test_ppo_trainer_hybrid_reshards_once_per_phase():
     assert len(history) == 2
     assert hybrid.stats()["reshards"] == 2, hybrid.stats()
     assert trainer._rollout_params is None
+
+
+def test_per_role_strategies_and_reshard_accounting():
+    """Each role runs under its OWN strategy (reference:
+    atorch/rl/model_engine/model_engine.py:35 accelerates every model
+    type separately): actor declares fsdp, critic SEARCHES its own
+    strategy, the frozen ref gets a tensor-sliced inference layout —
+    and every cross-layout transition lands in the per-role reshard
+    stats."""
+    import optax as _optax
+    from jax.sharding import Mesh
+
+    from dlrover_tpu.accel import Strategy
+    from dlrover_tpu.parallel.sharding import gpt_tp_rules
+    from dlrover_tpu.rl.hybrid_engine import HybridRolloutEngine
+    from dlrover_tpu.rl.rollout import (
+        make_actor_loss,
+        make_critic_loss,
+        ppo_iteration,
+        sample_rollout_batch,
+    )
+
+    cfg = GPTConfig.tiny(max_seq_len=64, vocab_size=32)
+    actor_model = GPT(cfg)
+    critic_model = GPT(
+        GPTConfig.tiny(max_seq_len=64, vocab_size=32, head="value")
+    )
+    ref_model = GPT(cfg)
+
+    prompt_len, max_new = 4, 8
+    prompts = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, prompt_len), dtype=np.int32
+        )
+    )
+    sample = sample_rollout_batch(prompts, max_new)
+    actor_params = actor_model.init_params(jax.random.PRNGKey(1))
+    ref_mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 4), ("data", "tensor")
+    )
+    engine = RLModelEngine(sample, {
+        ModelRole.ACTOR: RoleSpec(
+            model=actor_model,
+            loss_fn=make_actor_loss(actor_model, prompt_len),
+            optim_factory=lambda: _optax.adam(5e-3),
+            strategy=Strategy(opts=[("fsdp", {})]),
+        ),
+        ModelRole.CRITIC: RoleSpec(
+            model=critic_model,
+            loss_fn=make_critic_loss(critic_model, prompt_len),
+            optim_factory=lambda: _optax.adam(1e-3),
+            search=True, rank_mode="cost_model", cost_budget=3,
+        ),
+        ModelRole.REF: RoleSpec(
+            model=ref_model, params=actor_params,
+            mesh=ref_mesh, rules=gpt_tp_rules(),
+        ),
+    }).build()
+
+    report = engine.role_report()
+    # >=2 distinct role strategies (actor declared, critic searched)
+    assert report[ModelRole.ACTOR]["strategy"] != \
+        report[ModelRole.CRITIC]["strategy"] or \
+        report[ModelRole.CRITIC]["searched"]
+    assert report[ModelRole.CRITIC]["searched"] is True
+    assert report[ModelRole.REF]["layout"] == "sharded"
+
+    # the ref params actually live tensor-sliced
+    ref_leaves = jax.tree_util.tree_leaves(
+        engine._frozen_params[ModelRole.REF]
+    )
+    assert any(
+        "tensor" in str(l.sharding.spec) for l in ref_leaves
+        if hasattr(l.sharding, "spec")
+    )
+
+    # a PPO iteration through the per-role layouts still works
+    rollout_mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 4), ("data", "tensor")
+    )
+    hybrid = HybridRolloutEngine(engine, rollout_mesh)
+
+    def reward_fn(sequences):
+        resp = sequences[:, prompt_len:]
+        return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+    metrics = ppo_iteration(
+        engine, prompts, jax.random.PRNGKey(2),
+        max_new_tokens=max_new, kl_coef=0.01,
+        reward_fn=reward_fn, hybrid=hybrid,
+    )
+    assert np.isfinite(metrics["mean_reward"])
+
+    # ref refresh is a cross-layout reshard (actor fsdp -> ref tp)
+    engine.sync_ref_from_actor()
+    stats = engine.role_report()
+    assert stats[ModelRole.ACTOR]["reshards"] >= 1   # rollout swap
+    assert stats[ModelRole.REF]["reshards"] == 1     # ref refresh
+    assert stats[ModelRole.REF]["mean_reshard_s"] >= 0
+    # the refreshed ref kept its tensor-sliced layout
+    ref_leaves = jax.tree_util.tree_leaves(
+        engine._frozen_params[ModelRole.REF]
+    )
+    assert any(
+        "tensor" in str(l.sharding.spec) for l in ref_leaves
+        if hasattr(l.sharding, "spec")
+    )
