@@ -18,18 +18,50 @@
 // linear probing) storing row indices into a slab of embedding rows;
 // per-key update counters back frequency-based eviction.
 
+// Hybrid two-tier storage (reference: tfplus hybrid_embedding/
+// table_manager.h + storage_table.h + embedding_context.h): DRAM
+// holds the hot rows; frequency-cold rows spill to an on-disk record
+// file and are transparently promoted back on gather miss.  The key
+// index of the disk tier stays in DRAM (16-32 B/key vs dim*4 B/row).
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <random>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
 
 constexpr int64_t kEmptyKey = INT64_MIN;
+
+// On-disk cold tier: fixed-size records [dim*f32 values][u64 freq]
+// addressed by slot, with an in-DRAM key->slot index and a free list.
+struct SpillTier {
+  int fd = -1;
+  std::string path;
+  std::unordered_map<int64_t, int64_t> index;  // key -> slot
+  std::vector<int64_t> free_slots;
+  int64_t next_slot = 0;
+  size_t rec_bytes = 0;
+  long spills = 0;       // rows written out (cumulative)
+  long promotions = 0;   // rows read back on miss (cumulative)
+
+  ~SpillTier() {
+    if (fd >= 0) ::close(fd);
+    if (!path.empty()) ::unlink(path.c_str());
+  }
+};
 
 struct Table {
   int dim = 0;
@@ -43,6 +75,8 @@ struct Table {
   size_t used = 0;
   uint64_t seed = 0x9e3779b97f4a7c15ull;
   std::mutex mu;
+  std::unique_ptr<SpillTier> spill;
+  size_t max_dram_rows = 0;  // 0 = unbounded (no spilling)
 
   explicit Table(int d, size_t capacity) : dim(d) {
     size_t cap = 64;
@@ -127,6 +161,124 @@ struct Table {
   }
 
   float* row_ptr(int64_t row) { return values.data() + row * dim; }
+
+  // -- cold tier ------------------------------------------------------
+
+  // write one record to the spill file; key must not be in the
+  // index.  Returns false (and registers nothing) when the write
+  // does not land whole — the caller must then KEEP the DRAM row,
+  // or the key's trained state would silently reset to re-init on
+  // its next gather.
+  bool spill_write(int64_t key, const float* vals, uint64_t fq) {
+    int64_t slot;
+    if (!spill->free_slots.empty()) {
+      slot = spill->free_slots.back();
+      spill->free_slots.pop_back();
+    } else {
+      slot = spill->next_slot++;
+    }
+    std::vector<char> buf(spill->rec_bytes);
+    std::memcpy(buf.data(), vals, sizeof(float) * dim);
+    std::memcpy(buf.data() + sizeof(float) * dim, &fq, sizeof(fq));
+    ssize_t wrote = ::pwrite(spill->fd, buf.data(), spill->rec_bytes,
+                             static_cast<off_t>(slot) * spill->rec_bytes);
+    if (wrote != static_cast<ssize_t>(spill->rec_bytes)) {
+      spill->free_slots.push_back(slot);  // disk full / IO error
+      return false;
+    }
+    spill->index[key] = slot;
+    ++spill->spills;
+    return true;
+  }
+
+  // read a record without removing it (export paths)
+  bool spill_read(int64_t slot, float* vals_out, uint64_t* freq_out) {
+    std::vector<char> buf(spill->rec_bytes);
+    ssize_t got = ::pread(spill->fd, buf.data(), spill->rec_bytes,
+                          static_cast<off_t>(slot) * spill->rec_bytes);
+    if (got != static_cast<ssize_t>(spill->rec_bytes)) return false;
+    if (vals_out) std::memcpy(vals_out, buf.data(), sizeof(float) * dim);
+    if (freq_out) {
+      std::memcpy(freq_out, buf.data() + sizeof(float) * dim,
+                  sizeof(uint64_t));
+    }
+    return true;
+  }
+
+  // disk -> DRAM on gather miss; returns DRAM row or -1
+  int64_t promote(int64_t key) {
+    if (!spill) return -1;
+    auto it = spill->index.find(key);
+    if (it == spill->index.end()) return -1;
+    std::vector<float> vals(dim);
+    uint64_t fq = 0;
+    if (!spill_read(it->second, vals.data(), &fq)) return -1;
+    spill->free_slots.push_back(it->second);
+    spill->index.erase(it);
+    ++spill->promotions;
+    int64_t row = insert(key, vals.data(), false);
+    freq[row] = fq;
+    return row;
+  }
+
+  int64_t find_or_promote(int64_t key) {
+    int64_t row = find(key);
+    if (row < 0) row = promote(key);
+    return row;
+  }
+
+  // DRAM over budget -> move the coldest rows to disk.  10%
+  // hysteresis amortizes the O(used*dim) slab rebuild across
+  // ~max/10 inserts.
+  void maybe_spill_cold() {
+    if (!spill || max_dram_rows == 0 || used <= max_dram_rows) return;
+    size_t target = max_dram_rows - max_dram_rows / 10;
+    size_t n_spill = used - target;
+    // frequency threshold: the n_spill coldest rows go out
+    std::vector<uint64_t> fr(freq);
+    std::nth_element(fr.begin(), fr.begin() + n_spill - 1, fr.end());
+    uint64_t cutoff = fr[n_spill - 1];
+    // strictly-below-cutoff rows all spill (there are < n_spill of
+    // them by construction); rows AT the cutoff fill the remaining
+    // quota — quota must never be eaten by the tie class while a
+    // strictly colder row stays resident
+    size_t n_below = 0;
+    for (uint64_t f : freq) n_below += (f < cutoff);
+    size_t at_quota = n_spill - n_below;
+    std::vector<int64_t> keep_keys;
+    std::vector<float> keep_values;
+    std::vector<uint64_t> keep_freq;
+    keep_keys.reserve(target);
+    keep_freq.reserve(target);
+    keep_values.reserve(target * dim);
+    size_t at_spilled = 0;
+    for (size_t i = 0; i < row_keys.size(); ++i) {
+      bool cold = freq[i] < cutoff ||
+                  (freq[i] == cutoff && at_spilled < at_quota);
+      if (cold && spill_write(row_keys[i], row_ptr(i), freq[i])) {
+        if (freq[i] == cutoff) ++at_spilled;
+      } else {
+        keep_keys.push_back(row_keys[i]);
+        keep_freq.push_back(freq[i]);
+        size_t off = keep_values.size();
+        keep_values.resize(off + dim);
+        std::memcpy(keep_values.data() + off, row_ptr(i),
+                    sizeof(float) * dim);
+      }
+    }
+    row_keys = std::move(keep_keys);
+    values = std::move(keep_values);
+    freq = std::move(keep_freq);
+    used = row_keys.size();
+    std::fill(keys.begin(), keys.end(), kEmptyKey);
+    std::fill(rows.begin(), rows.end(), -1);
+    for (size_t i = 0; i < row_keys.size(); ++i) {
+      size_t slot = hash_key(row_keys[i]) & mask();
+      while (keys[slot] != kEmptyKey) slot = (slot + 1) & mask();
+      keys[slot] = row_keys[i];
+      rows[slot] = static_cast<int64_t>(i);
+    }
+  }
 };
 
 }  // namespace
@@ -141,8 +293,53 @@ void* kv_create(int dim, long initial_capacity, unsigned long seed) {
 
 void kv_destroy(void* handle) { delete static_cast<Table*>(handle); }
 
+// Logical row count: DRAM + spilled (the table's full key set).
 long kv_size(void* handle) {
-  return static_cast<long>(static_cast<Table*>(handle)->used);
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  size_t n = t->used;
+  if (t->spill) n += t->spill->index.size();
+  return static_cast<long>(n);
+}
+
+// Enable the on-disk cold tier: rows beyond max_dram_rows spill to
+// `path` coldest-first and promote back on access.  Returns 0 on
+// success, -1 if the file cannot be opened.
+int kv_spill_enable(void* handle, const char* path, long max_dram_rows) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  if (t->spill) {
+    // already enabled: replacing the tier would free the only index
+    // of the disk-resident rows (and ~SpillTier would unlink the
+    // file).  Same path = a budget adjustment; different path is an
+    // error the caller must see.
+    if (t->spill->path != path) return -2;
+    t->max_dram_rows =
+        max_dram_rows > 0 ? static_cast<size_t>(max_dram_rows) : 0;
+    t->maybe_spill_cold();
+    return 0;
+  }
+  auto tier = std::unique_ptr<SpillTier>(new SpillTier());
+  tier->fd = ::open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (tier->fd < 0) return -1;
+  tier->path = path;
+  tier->rec_bytes = sizeof(float) * t->dim + sizeof(uint64_t);
+  t->spill = std::move(tier);
+  t->max_dram_rows =
+      max_dram_rows > 0 ? static_cast<size_t>(max_dram_rows) : 0;
+  t->maybe_spill_cold();  // an already-over-budget table spills now
+  return 0;
+}
+
+// out[0]=rows on disk, out[1]=cumulative spills, out[2]=cumulative
+// promotions, out[3]=DRAM rows.
+void kv_spill_stats(void* handle, long* out) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  out[0] = t->spill ? static_cast<long>(t->spill->index.size()) : 0;
+  out[1] = t->spill ? t->spill->spills : 0;
+  out[2] = t->spill ? t->spill->promotions : 0;
+  out[3] = static_cast<long>(t->used);
 }
 
 int kv_dim(void* handle) { return static_cast<Table*>(handle)->dim; }
@@ -155,7 +352,7 @@ void kv_gather(void* handle, const int64_t* keys, long n, float* out,
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
   for (long i = 0; i < n; ++i) {
-    int64_t row = t->find(keys[i]);
+    int64_t row = t->find_or_promote(keys[i]);
     if (row < 0 && insert_missing) {
       row = t->insert(keys[i], nullptr, random_init != 0);
     }
@@ -167,6 +364,7 @@ void kv_gather(void* handle, const int64_t* keys, long n, float* out,
                   sizeof(float) * t->dim);
     }
   }
+  t->maybe_spill_cold();
 }
 
 // Explicit insert/assign (reference: KvVariableInsert).
@@ -175,7 +373,7 @@ void kv_insert(void* handle, const int64_t* keys, const float* vals,
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
   for (long i = 0; i < n; ++i) {
-    int64_t row = t->find(keys[i]);
+    int64_t row = t->find_or_promote(keys[i]);
     if (row < 0) {
       t->insert(keys[i], vals + i * t->dim, false);
     } else {
@@ -183,6 +381,7 @@ void kv_insert(void* handle, const int64_t* keys, const float* vals,
                   sizeof(float) * t->dim);
     }
   }
+  t->maybe_spill_cold();
 }
 
 // op: 0=add 1=sub 2=mul (reference: KvVariableScatterAdd/Sub/Mul).
@@ -191,7 +390,7 @@ void kv_scatter(void* handle, const int64_t* keys, const float* vals,
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
   for (long i = 0; i < n; ++i) {
-    int64_t row = t->find(keys[i]);
+    int64_t row = t->find_or_promote(keys[i]);
     if (row < 0) row = t->insert(keys[i], nullptr, false);
     float* dst = t->row_ptr(row);
     const float* src = vals + i * t->dim;
@@ -201,6 +400,7 @@ void kv_scatter(void* handle, const int64_t* keys, const float* vals,
       else dst[d] *= src[d];
     }
   }
+  t->maybe_spill_cold();
 }
 
 // Export all rows (checkpoint).  keys_out: [size], values_out:
@@ -215,6 +415,18 @@ long kv_export(void* handle, int64_t* keys_out, float* values_out,
     freq_out[i] = t->freq[i];
   }
   std::memcpy(values_out, t->values.data(), sizeof(float) * n * t->dim);
+  // a checkpoint must cover the FULL logical table: append the cold
+  // tier's rows after the DRAM ones
+  if (t->spill) {
+    for (const auto& kv : t->spill->index) {
+      if (n >= max_n) break;
+      keys_out[n] = kv.first;
+      if (t->spill_read(kv.second, values_out + n * t->dim,
+                        freq_out + n)) {
+        ++n;
+      }
+    }
+  }
   return n;
 }
 
@@ -225,6 +437,12 @@ long kv_export_freq(void* handle, uint64_t* freq_out, long max_n) {
   std::lock_guard<std::mutex> lock(t->mu);
   long n = std::min<long>(max_n, static_cast<long>(t->freq.size()));
   for (long i = 0; i < n; ++i) freq_out[i] = t->freq[i];
+  if (t->spill) {  // eviction math sees the cold tier's counts too
+    for (const auto& kv : t->spill->index) {
+      if (n >= max_n) break;
+      if (t->spill_read(kv.second, nullptr, freq_out + n)) ++n;
+    }
+  }
   return n;
 }
 
@@ -233,12 +451,13 @@ void kv_import(void* handle, const int64_t* keys, const float* vals,
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
   for (long i = 0; i < n; ++i) {
-    int64_t row = t->find(keys[i]);
+    int64_t row = t->find_or_promote(keys[i]);
     if (row < 0) row = t->insert(keys[i], vals + i * t->dim, false);
     else std::memcpy(t->row_ptr(row), vals + i * t->dim,
                      sizeof(float) * t->dim);
     if (freqs) t->freq[row] = freqs[i];
   }
+  t->maybe_spill_cold();
 }
 
 void kv_frequency(void* handle, const int64_t* keys, long n,
@@ -247,7 +466,19 @@ void kv_frequency(void* handle, const int64_t* keys, long n,
   std::lock_guard<std::mutex> lock(t->mu);
   for (long i = 0; i < n; ++i) {
     int64_t row = t->find(keys[i]);
-    out[i] = row < 0 ? 0 : t->freq[row];
+    if (row >= 0) {
+      out[i] = t->freq[row];
+    } else if (t->spill) {
+      // read-only query: report the cold row's count WITHOUT
+      // promoting it (a frequency probe must not churn the tiers)
+      auto it = t->spill->index.find(keys[i]);
+      uint64_t fq = 0;
+      out[i] = (it != t->spill->index.end() &&
+                t->spill_read(it->second, nullptr, &fq))
+                   ? fq : 0;
+    } else {
+      out[i] = 0;
+    }
   }
 }
 
@@ -256,6 +487,20 @@ void kv_frequency(void* handle, const int64_t* keys, long n,
 long kv_evict_below(void* handle, uint64_t min_freq) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
+  long disk_evicted = 0;
+  if (t->spill) {  // eviction (deletion) applies to the cold tier too
+    for (auto it = t->spill->index.begin();
+         it != t->spill->index.end();) {
+      uint64_t fq = 0;
+      if (t->spill_read(it->second, nullptr, &fq) && fq < min_freq) {
+        t->spill->free_slots.push_back(it->second);
+        it = t->spill->index.erase(it);
+        ++disk_evicted;
+      } else {
+        ++it;
+      }
+    }
+  }
   std::vector<int64_t> keep_keys;
   std::vector<float> keep_values;
   std::vector<uint64_t> keep_freq;
@@ -284,7 +529,7 @@ long kv_evict_below(void* handle, uint64_t min_freq) {
     t->keys[slot] = t->row_keys[i];
     t->rows[slot] = static_cast<int64_t>(i);
   }
-  return evicted;
+  return evicted + disk_evicted;
 }
 
 // ---------------------------------------------------------------------
@@ -309,11 +554,11 @@ void kv_apply_group_adam(void* param_h, void* m_h, void* v_h,
   const float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
   const float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
   for (long i = 0; i < n; ++i) {
-    int64_t prow = p->find(keys[i]);
+    int64_t prow = p->find_or_promote(keys[i]);
     if (prow < 0) prow = p->insert(keys[i], nullptr, true);
-    int64_t mrow = m->find(keys[i]);
+    int64_t mrow = m->find_or_promote(keys[i]);
     if (mrow < 0) mrow = m->insert(keys[i], nullptr, false);
-    int64_t vrow = v->find(keys[i]);
+    int64_t vrow = v->find_or_promote(keys[i]);
     if (vrow < 0) vrow = v->insert(keys[i], nullptr, false);
     float* w = p->row_ptr(prow);
     float* mu = m->row_ptr(mrow);
@@ -329,6 +574,9 @@ void kv_apply_group_adam(void* param_h, void* m_h, void* v_h,
       w[d] -= lr * mhat / (std::sqrt(vhat) + eps);
     }
   }
+  p->maybe_spill_cold();
+  m->maybe_spill_cold();
+  v->maybe_spill_cold();
 }
 
 // Group Adagrad step.
@@ -341,9 +589,9 @@ void kv_apply_group_adagrad(void* param_h, void* acc_h,
   std::lock_guard<std::mutex> la(a->mu);
   const int dim = p->dim;
   for (long i = 0; i < n; ++i) {
-    int64_t prow = p->find(keys[i]);
+    int64_t prow = p->find_or_promote(keys[i]);
     if (prow < 0) prow = p->insert(keys[i], nullptr, true);
-    int64_t arow = a->find(keys[i]);
+    int64_t arow = a->find_or_promote(keys[i]);
     if (arow < 0) {
       a->insert(keys[i], nullptr, false);
       arow = a->find(keys[i]);
@@ -359,6 +607,8 @@ void kv_apply_group_adagrad(void* param_h, void* acc_h,
       w[d] -= lr * g[d] / (std::sqrt(acc[d]) + eps);
     }
   }
+  p->maybe_spill_cold();
+  a->maybe_spill_cold();
 }
 
 // Group FTRL step (reference: training/group_ftrl.py semantics).
@@ -373,11 +623,11 @@ void kv_apply_group_ftrl(void* param_h, void* z_h, void* n_h,
   std::lock_guard<std::mutex> ln(nt->mu);
   const int dim = p->dim;
   for (long i = 0; i < n; ++i) {
-    int64_t prow = p->find(keys[i]);
+    int64_t prow = p->find_or_promote(keys[i]);
     if (prow < 0) prow = p->insert(keys[i], nullptr, false);
-    int64_t zrow = zt->find(keys[i]);
+    int64_t zrow = zt->find_or_promote(keys[i]);
     if (zrow < 0) zrow = zt->insert(keys[i], nullptr, false);
-    int64_t nrow = nt->find(keys[i]);
+    int64_t nrow = nt->find_or_promote(keys[i]);
     if (nrow < 0) nrow = nt->insert(keys[i], nullptr, false);
     float* w = p->row_ptr(prow);
     float* z = zt->row_ptr(zrow);
@@ -399,6 +649,9 @@ void kv_apply_group_ftrl(void* param_h, void* z_h, void* n_h,
       }
     }
   }
+  p->maybe_spill_cold();
+  zt->maybe_spill_cold();
+  nt->maybe_spill_cold();
 }
 
 }  // extern "C"

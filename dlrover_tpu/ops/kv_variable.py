@@ -67,6 +67,13 @@ def _load():
         ]
         lib.kv_evict_below.restype = ctypes.c_long
         lib.kv_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_spill_enable.restype = ctypes.c_int
+        lib.kv_spill_enable.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+        ]
+        lib.kv_spill_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_long),
+        ]
         lib.kv_apply_group_adam.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             i64p, f32p, ctypes.c_long,
@@ -160,6 +167,36 @@ class KvVariable:
         self._lib.kv_scatter(
             self._handle, _i64(keys), _f32(values), keys.size, op
         )
+
+    def enable_spill(self, path: str, max_dram_rows: int) -> None:
+        """Turn on the hybrid two-tier storage (reference: tfplus
+        hybrid_embedding/table_manager.h): DRAM keeps at most
+        ``max_dram_rows`` hot rows; frequency-cold rows spill to the
+        record file at ``path`` and are transparently promoted back
+        on gather miss.  Gather/scatter/optimizer semantics are
+        unchanged — only residence moves."""
+        rc = self._lib.kv_spill_enable(
+            self._handle, path.encode(), max_dram_rows
+        )
+        if rc == -2:
+            raise ValueError(
+                "spill already enabled with a different path; "
+                "re-calling with the SAME path adjusts the DRAM "
+                "budget, replacing the tier would orphan the "
+                "disk-resident rows"
+            )
+        if rc != 0:
+            raise OSError(f"cannot open spill file {path!r}")
+
+    def spill_stats(self) -> dict:
+        out = (ctypes.c_long * 4)()
+        self._lib.kv_spill_stats(self._handle, out)
+        return {
+            "disk_rows": int(out[0]),
+            "spills": int(out[1]),
+            "promotions": int(out[2]),
+            "dram_rows": int(out[3]),
+        }
 
     def frequency(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
@@ -314,6 +351,21 @@ class GroupAdamOptimizer:
             _i64(keys), _f32(grads), keys.size,
             self.lr, self.beta1, self.beta2, self.eps,
             self.weight_decay, self.step,
+        )
+
+    def enable_spill(self, directory: str, max_dram_rows: int) -> None:
+        """Spill the moment tables alongside the (separately
+        configured or not) parameter table — training past DRAM
+        needs ALL per-key state bounded, not just the embeddings."""
+        import os as _os
+
+        self.m.enable_spill(
+            _os.path.join(directory, f"{self.table.name}_m.spill"),
+            max_dram_rows,
+        )
+        self.v.enable_spill(
+            _os.path.join(directory, f"{self.table.name}_v.spill"),
+            max_dram_rows,
         )
 
 

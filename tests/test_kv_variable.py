@@ -225,3 +225,91 @@ def test_export_freq_matches_export():
     _, _, full = kv.export()
     only = kv.export_freq()
     assert sorted(full.tolist()) == sorted(only.tolist())
+
+
+def test_spill_tier_transparent_residence(tmp_path):
+    """Hybrid two-tier storage (reference: tfplus hybrid_embedding/
+    table_manager.h): cold rows move to disk when DRAM is over
+    budget, gather on a spilled key promotes it back with value AND
+    frequency intact."""
+    table = KvVariable(dim=8, initial_capacity=64, seed=7)
+    keys = np.arange(1000, dtype=np.int64)
+    vals = np.arange(8000, dtype=np.float32).reshape(1000, 8)
+    table.insert(keys, vals)
+    # heat up the first 100 keys so they stay resident
+    for _ in range(3):
+        table.gather(keys[:100])
+    table.enable_spill(str(tmp_path / "kv.spill"), max_dram_rows=200)
+    stats = table.spill_stats()
+    assert stats["dram_rows"] <= 200
+    assert stats["disk_rows"] == 1000 - stats["dram_rows"]
+    assert len(table) == 1000  # logical size covers both tiers
+    # a cold key gathers back with its exact value (promotion)
+    cold = np.array([777], dtype=np.int64)
+    got = table.gather(cold, insert_missing=False)
+    np.testing.assert_allclose(got[0], vals[777])
+    assert table.spill_stats()["promotions"] >= 1
+    # frequency survives the round trip (hot keys still counted)
+    assert int(table.frequency(keys[:1])[0]) >= 3
+
+
+def test_spill_tier_export_covers_both_tiers(tmp_path):
+    table = KvVariable(dim=4, initial_capacity=32, seed=1)
+    keys = np.arange(500, dtype=np.int64)
+    vals = np.random.default_rng(0).normal(
+        size=(500, 4)
+    ).astype(np.float32)
+    table.insert(keys, vals)
+    table.enable_spill(str(tmp_path / "kv.spill"), max_dram_rows=100)
+    ek, ev, ef = table.export()
+    assert len(ek) == 500
+    order = np.argsort(ek)
+    np.testing.assert_allclose(ev[order], vals, rtol=1e-6)
+
+
+def test_spill_training_past_dram_loss_parity(tmp_path):
+    """Training with per-key state bounded to a fraction of the key
+    space reaches the SAME result as unbounded DRAM (the done
+    criterion for the hybrid tier): same keys, same grads, same
+    final embeddings."""
+    rng = np.random.default_rng(3)
+    n_keys, dim, batch, steps = 2000, 8, 256, 30
+
+    def run(spill: bool):
+        table = KvVariable(dim=dim, initial_capacity=64, seed=11)
+        opt = GroupAdamOptimizer(table, learning_rate=1e-2)
+        if spill:
+            table.enable_spill(
+                str(tmp_path / "p.spill"), max_dram_rows=300
+            )
+            opt.enable_spill(str(tmp_path), max_dram_rows=300)
+        krng = np.random.default_rng(42)
+        for s in range(steps):
+            keys = krng.integers(0, n_keys, batch).astype(np.int64)
+            emb = table.gather(keys)
+            grads = np.tanh(emb) * 0.1  # deterministic pseudo-grads
+            opt.apply_gradients(keys, grads)
+        all_keys = np.arange(n_keys, dtype=np.int64)
+        return table.gather(
+            all_keys, insert_missing=False, count_freq=False
+        ), table
+
+    dense_out, _ = run(False)
+    spill_out, spill_table = run(True)
+    st = spill_table.spill_stats()
+    assert st["spills"] > 0, st            # the tier actually engaged
+    assert st["promotions"] > 0, st        # cold keys were fetched back
+    assert st["dram_rows"] <= 300 + 30, st # budget held (hysteresis)
+    np.testing.assert_allclose(spill_out, dense_out, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_spill_tier_eviction_reaches_disk(tmp_path):
+    table = KvVariable(dim=4, initial_capacity=32)
+    keys = np.arange(400, dtype=np.int64)
+    table.gather(keys)              # freq 1 everywhere
+    table.gather(keys[:50])         # hot class freq 2
+    table.enable_spill(str(tmp_path / "kv.spill"), max_dram_rows=100)
+    evicted = table.evict_below(2)  # drops freq-1 rows on BOTH tiers
+    assert evicted == 350
+    assert len(table) == 50
