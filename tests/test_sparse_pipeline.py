@@ -105,3 +105,29 @@ def test_sparse_pipeline_staleness_is_one():
         else:
             assert seen == [0, 1, 2, 3], seen
         assert model.sparse_optimizer.step == 4
+
+
+def test_sparse_pipeline_auto_mode_decides_and_trains():
+    """pipeline='auto' probes the first batches strictly, commits to
+    one mode, records it, and still applies every sparse update."""
+    cfg = _cfg()
+    model = DeepFM(cfg)
+    optimizer = optax.adam(1e-2)
+    params = model.init_dense_params()
+    state = (params, optimizer.init(params))
+    step = make_deepfm_device_step(model, optimizer)
+    pipe = SparseTrainPipeline(
+        model.table, model.sparse_optimizer, step, pipeline="auto"
+    )
+    assert pipe.chosen_mode is None
+    losses = []
+    data = _batches(cfg, 5) * 4
+    state = pipe.run(
+        state, data, on_aux=lambda a: losses.append(a["loss"])
+    )
+    assert pipe.chosen_mode in ("pipelined", "strict")
+    rep = pipe.overlap_report()
+    assert rep["mode"] == pipe.chosen_mode
+    assert rep["steps"] == 20
+    assert model.sparse_optimizer.step == 20
+    assert all(np.isfinite(float(x)) for x in losses)
