@@ -356,6 +356,15 @@ class GPT(nn.Module):
         else:
             offset = 0
         x = wte(tokens) + wpe(offset + jnp.arange(s)[None])
+        # pin the residual stream to the batch layout when a mesh is
+        # active: free propagation invents iota-ordered intermediate
+        # shardings that permuted (multi-slice) meshes cannot
+        # transition out of efficiently
+        from dlrover_tpu.parallel.sharding import (
+            constrain_activation,
+        )
+
+        x = constrain_activation(x)
         block = Block
         if cfg.remat:
             block = nn.remat(

@@ -376,6 +376,20 @@ def pipeline_train_step_1f1b(
     x_spec = P(batch_axis) if batch_axis is not None else P()
     p_spec = jax.tree.map(lambda _: P(axis), stacked_params)
     hp_spec = jax.tree.map(lambda _: P(), hp_arg)
+    # pin the activations to the shard_map's own layout BEFORE the
+    # manual region: the embedding that produced x runs under
+    # XLA-propagated shardings (zero1/fsdp params leak into its
+    # output), and an unconstrained mismatch at this boundary makes
+    # SPMD fall back to replicate-then-partition ("Involuntary full
+    # rematerialization", VERDICT r4 weak #6)
+    from jax.sharding import NamedSharding
+
+    x = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, x_spec)
+    )
+    y = jax.lax.with_sharding_constraint(
+        y, NamedSharding(mesh, x_spec)
+    )
     loss, grads, head_grads, input_grads = jax.shard_map(
         local,
         mesh=mesh,
