@@ -371,9 +371,28 @@ def build_from_plan(
         out_shardings=(shardings, None),
         donate_argnums=0,
     )
+
+    from dlrover_tpu.parallel.mesh import (
+        activation_constraint_mesh,
+    )
+
+    def train_step(state, batch):
+        # activation-layout constraints are scoped to THIS mesh for
+        # the duration of the call (tracing happens inside it), so a
+        # model traced later under another mesh never inherits them
+        with activation_constraint_mesh(mesh):
+            return jitted(state, batch)
+
+    def lower(state, batch):
+        # the dry-runner cost model lowers without executing; same
+        # constraint scope applies during ITS tracing
+        with activation_constraint_mesh(mesh):
+            return jitted.lower(state, batch)
+
+    train_step.lower = lower
     state = jax.device_put(state, shardings)
     return BuiltPlan(
-        mesh=mesh, train_step=jitted, state=state, plan=plan,
+        mesh=mesh, train_step=train_step, state=state, plan=plan,
         model=model,
     )
 

@@ -73,16 +73,16 @@ class GPTConfig:
         return self.hidden_dim // self.num_heads
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "offload"):
+        if self.remat_policy not in ("full", "offload", "save_attn"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r} "
-                "(full | offload)"
+                "(full | offload | save_attn)"
             )
         if self.remat_policy != "full" and not self.remat:
             raise ValueError(
-                "remat_policy='offload' requires remat=True (the "
-                "policy chooses WHERE checkpoints live; remat "
-                "creates them)"
+                f"remat_policy={self.remat_policy!r} requires "
+                "remat=True (the policy chooses WHAT/WHERE to "
+                "checkpoint; remat creates the checkpoints)"
             )
 
     @classmethod
@@ -120,6 +120,16 @@ def _remat_policy(name: str):
             names_which_can_be_offloaded=["block_in"],
             offload_src="device",
             offload_dst="pinned_host",
+        )
+    if name == "save_attn":
+        # selective remat: keep each block's attention output
+        # ([b, s, hidden] bf16 per layer — hundreds of MB, not GB)
+        # so the backward re-runs only layernorm/MLP, never the
+        # flash-attention forward — the priciest recompute
+        import jax
+
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out"
         )
     raise ValueError(f"unknown remat_policy {name!r}")
 
@@ -304,7 +314,13 @@ class Block(nn.Module):
         h = nn.LayerNorm(
             epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_attn"
         )(x)
-        x = x + Attention(cfg, name="attn")(h.astype(cfg.dtype))
+        # named so the save_attn remat policy can keep it (the flash
+        # forward is the priciest recompute in a full-remat backward)
+        attn_out = checkpoint_name(
+            Attention(cfg, name="attn")(h.astype(cfg.dtype)),
+            "attn_out",
+        )
+        x = x + attn_out
         h = nn.LayerNorm(
             epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_mlp"
         )(x)

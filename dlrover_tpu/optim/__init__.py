@@ -8,7 +8,7 @@ kernels in :mod:`dlrover_tpu.ops.quantization`.
 """
 
 from dlrover_tpu.optim.agd import agd
-from dlrover_tpu.optim.bf16 import with_fp32_master
+from dlrover_tpu.optim.bf16 import adamw_bf16, with_fp32_master
 from dlrover_tpu.optim.came import came, q_adafactor, q_came
 from dlrover_tpu.optim.local_sgd import (
     diloco_outer_step,
@@ -20,6 +20,7 @@ from dlrover_tpu.optim.offload import adamw_offload, offload
 from dlrover_tpu.optim.wsam import sam_gradient, wsam
 
 __all__ = [
+    "adamw_bf16",
     "adamw_offload",
     "agd",
     "with_fp32_master",

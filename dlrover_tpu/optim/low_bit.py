@@ -124,15 +124,33 @@ def q_adamw(
             lr_t = None
             kernel_lr = learning_rate
 
-        def to_tiles(x):
-            return to_block_tiles(x, block_size)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        flat_p = treedef.flatten_up_to(params)
+        # tiles travel in the joint grad/param dtype (bf16 only when
+        # BOTH are bf16): lossless vs the inputs — fp32 params must
+        # not be rounded through bf16 tiles for the weight-decay
+        # term — while bf16 training halves the transient tile
+        # buffers; the kernel upcasts to f32 internally.  (Chunking
+        # leaves into concatenated mega-calls was tried and measured
+        # SLOWER — the concat/split traffic exceeds the per-leaf
+        # dispatch cost on TPU, where the whole step is one compiled
+        # program anyway.)
+        tile_dtype = jnp.result_type(
+            *[l.dtype for l in flat_g],
+            *[l.dtype for l in flat_p],
+        )
+        if tile_dtype not in (jnp.bfloat16, jnp.float32):
+            tile_dtype = jnp.float32
 
         def leaf_update(g, qmu, qnu, p):
             # single fused Pallas pass: dequant moments -> Adam math ->
             # requant + update, moments never hit HBM at fp32
             # (reference: quantization_optimizer.cu)
             upd_t, qm, ms, qn, ns = fused_qadam_step(
-                to_tiles(g), to_tiles(p),
+                to_block_tiles(g, block_size, tile_dtype),
+                to_block_tiles(p, block_size, tile_dtype),
                 qmu.values, qmu.scales, qnu.values, qnu.scales,
                 bias_corr,
                 b1=b1, b2=b2, eps=eps, lr=kernel_lr,
@@ -147,10 +165,6 @@ def q_adamw(
                 QMoment(values=qn, scales=ns),
             )
 
-        flat_g, treedef = jax.tree_util.tree_flatten(grads)
-        flat_mu = treedef.flatten_up_to(state.mu)
-        flat_nu = treedef.flatten_up_to(state.nu)
-        flat_p = treedef.flatten_up_to(params)
         out = [
             leaf_update(g, m, n, p)
             for g, m, n, p in zip(flat_g, flat_mu, flat_nu, flat_p)

@@ -19,7 +19,9 @@ On a TPU pod slice the mesh should be laid out so ``tensor`` and
 ordering.
 """
 
+import contextlib
 import math
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -198,18 +200,9 @@ def build_mesh(
         )
     sizes = config.axis_sizes(len(devices))
     if num_slices > 1:
-        arr = _hybrid_device_array(sizes, devices, num_slices)
-        mesh = Mesh(arr, AXES)
-        # when the hybrid assembly is an actual permutation of iota
-        # (real TPU slices with topology-ordered ICI blocks), models
-        # pin their activation layouts on it (see
-        # sharding.constrain_activation): free SPMD propagation
-        # invents iota-ordered intermediates the partitioner cannot
-        # transition out of efficiently
-        flat_ids = [d.id for d in arr.flat]
-        if flat_ids != sorted(flat_ids):
-            mesh.dlrover_permuted = True
-        return mesh
+        return Mesh(
+            _hybrid_device_array(sizes, devices, num_slices), AXES
+        )
     shape = tuple(sizes[a] for a in AXES)
     return Mesh(_ici_device_array(shape, devices), AXES)
 
@@ -260,6 +253,44 @@ def _hybrid_device_array(
 
 
 _GLOBAL_MESH = None
+
+# mesh whose ACTIVATION-layout constraints are currently in force —
+# scoped (not global) so a computation traced under a different mesh
+# (e.g. the RL rollout layout swap) never inherits the training
+# mesh's constraints.  Set by the accelerate train-step wrapper.
+_ACTIVATION_MESH = threading.local()
+
+
+@contextlib.contextmanager
+def activation_constraint_mesh(mesh):
+    """Scope within which models pin their activation layouts to
+    ``mesh`` (see ``sharding.constrain_activation``).  Wraps the
+    train-step CALL so the constraint is visible while jax traces
+    the step, and only then."""
+    prev = getattr(_ACTIVATION_MESH, "mesh", None)
+    _ACTIVATION_MESH.mesh = mesh
+    try:
+        yield
+    finally:
+        _ACTIVATION_MESH.mesh = prev
+
+
+def get_activation_constraint_mesh():
+    return getattr(_ACTIVATION_MESH, "mesh", None)
+
+
+def mesh_is_permuted(mesh) -> bool:
+    """True when the mesh's device assignment is not iota-ordered —
+    derived from ANY mesh (not just build_mesh's), since XLA's legacy
+    SPMD partitioner only mishandles layout transitions on permuted
+    assignments.  Computed fresh each call: it is a trivial id scan,
+    and an id(mesh)-keyed cache would serve stale verdicts when a
+    collected mesh's address is recycled."""
+    try:
+        ids = [d.id for d in np.asarray(mesh.devices).flat]
+        return ids != sorted(ids)
+    except (AttributeError, TypeError):
+        return False
 
 
 def set_global_mesh(mesh):

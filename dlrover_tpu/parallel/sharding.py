@@ -247,14 +247,19 @@ def constrain_activation(x, spec=None):
     to the mesh's permuted order is an "Involuntary full
     rematerialization" (replicate-then-partition) — the exact warning
     VERDICT r4 weak #6 flags."""
-    from dlrover_tpu.parallel import mesh as mesh_mod
+    from dlrover_tpu.parallel.mesh import (
+        get_activation_constraint_mesh,
+        mesh_is_permuted,
+    )
 
-    mesh = mesh_mod._GLOBAL_MESH
-    if mesh is None or not getattr(mesh, "dlrover_permuted", False):
-        # iota meshes: propagation already finds efficient layouts,
-        # and an unconditional global-mesh constraint would leak into
-        # computations legitimately running under a different mesh
-        # (e.g. the RL rollout layout swap)
+    # SCOPED, not global: only the mesh the enclosing train step was
+    # built for (set around its call by accelerate) may constrain
+    # activations — a computation traced under a different mesh (the
+    # RL rollout layout swap, a frozen-role infer) must not inherit
+    # the training mesh's layout.  Iota meshes no-op: propagation
+    # already finds efficient layouts there.
+    mesh = get_activation_constraint_mesh()
+    if mesh is None or not mesh_is_permuted(mesh):
         return x
     import jax
     from jax.sharding import NamedSharding
