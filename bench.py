@@ -1839,6 +1839,16 @@ def _headline(snapshot: dict) -> dict:
     put("goodput_pct", _dig(snapshot, "goodput", "goodput_pct"))
     put("goodput_kills", _dig(snapshot, "goodput", "kills_delivered"))
     put(
+        "goodput_lost_s", _dig(snapshot, "goodput", "churn_lost_s")
+    )
+    put(
+        "goodput_worst_cycle_s",
+        _dig(
+            snapshot, "goodput", "phase_breakdown", "total_lost_s",
+            "max",
+        ),
+    )
+    put(
         "llama_mfu_2048",
         _dig(snapshot, "llama_train_step", "seq2048", "mfu"),
     )

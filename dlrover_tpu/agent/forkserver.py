@@ -135,6 +135,51 @@ def _template_main(req_fd: int, ev_fd: int):
         if pid == 0:
             # ---- child: become the worker
             try:
+                boost = spec.get("nice_boost")
+                if boost:
+                    # recovery boost: the respawned worker's restore +
+                    # retrace must not be starved by other host load
+                    # (the goodput killer in practice); bounded — a
+                    # timer returns it to normal priority
+                    try:
+                        # who=getpid(), NOT 0: on Linux who=0 means
+                        # the CALLING THREAD, and the unboost below
+                        # runs on a side thread — with 0 it would
+                        # renice itself while the training main
+                        # thread kept the boost forever
+                        me = os.getpid()
+                        os.setpriority(
+                            os.PRIO_PROCESS, me, int(boost["nice"])
+                        )
+
+                        def _unboost(
+                            sec=float(boost.get("seconds", 20.0)),
+                        ):
+                            time.sleep(sec)
+                            # nice is PER-THREAD on Linux and
+                            # setpriority(PRIO_PROCESS, pid) renices
+                            # only tid==pid: every thread the worker
+                            # created during the boost (XLA's pools
+                            # do the steady-state compute!) must be
+                            # reniced too, or the boost is unbounded
+                            # for exactly the hottest threads
+                            try:
+                                tids = os.listdir("/proc/self/task")
+                            except OSError:
+                                tids = [str(me)]
+                            for tid in tids:
+                                try:
+                                    os.setpriority(
+                                        os.PRIO_PROCESS, int(tid), 0
+                                    )
+                                except (OSError, ValueError):
+                                    pass
+
+                        threading.Thread(
+                            target=_unboost, daemon=True
+                        ).start()
+                    except (OSError, PermissionError):
+                        pass  # not privileged: run unboosted
                 os.environ.clear()
                 os.environ.update(spec["env"])
                 _sync_jax_config_from_env()
@@ -231,6 +276,12 @@ class WorkerForkServer:
         self._spawned: List[int] = []
         self._spawn_results: Dict[int, int] = {}  # req id -> pid
         self._abandoned: set = set()  # req ids whose caller timed out
+        # which template GENERATION forked each pid: exit events for
+        # a pid only ever come from its own template, so once that
+        # template is gone (close + rebuild), liveness must be
+        # probed directly or the handle polls None forever
+        self._pid_generation: Dict[int, int] = {}
+        self._generation = 0
         self._next_req = 0
         self._lock = threading.Lock()
         # spawn requests are serialized: the pipe is a shared stream
@@ -241,6 +292,7 @@ class WorkerForkServer:
     def _ensure_template(self):
         if self._proc is not None and self._proc.poll() is None:
             return
+        self._generation += 1
         req_r, req_w = os.pipe()
         ev_r, ev_w = os.pipe()
         env = dict(os.environ, DLROVER_PRELOAD=self._preload)
@@ -289,25 +341,31 @@ class WorkerForkServer:
     def spawn(
         self, argv: List[str], env: Dict[str, str],
         timeout: float = 30.0,
+        nice_boost: Optional[Dict] = None,
     ) -> ForkedWorkerHandle:
         """Fork the template into a worker running ``argv`` (argv[0]
         is the script path — the interpreter is already running).
         Requests carry an id echoed back in the spawned event, so
-        concurrent callers each get their own pid."""
+        concurrent callers each get their own pid.  ``nice_boost``
+        ({"nice": N, "seconds": S}) starts the worker at scheduling
+        priority N for its first S seconds — the recovery path's
+        restore+retrace must not be starved by host load."""
         with self._spawn_lock:
             self._ensure_template()
             req_id = self._next_req
             self._next_req += 1
-            self._req.write(
-                json.dumps({"req": req_id, "env": env, "argv": argv})
-                + "\n"
-            )
+            msg = {"req": req_id, "env": env, "argv": argv}
+            if nice_boost:
+                msg["nice_boost"] = nice_boost
+            self._req.write(json.dumps(msg) + "\n")
             self._req.flush()
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._lock:
                 pid = self._spawn_results.pop(req_id, None)
             if pid is not None:
+                with self._lock:
+                    self._pid_generation[pid] = self._generation
                 return ForkedWorkerHandle(pid, self)
             time.sleep(0.01)
         with self._lock:
@@ -319,6 +377,8 @@ class WorkerForkServer:
             if late is None:
                 self._abandoned.add(req_id)
         if late is not None:  # landed between the last poll and now
+            with self._lock:
+                self._pid_generation[late] = self._generation
             return ForkedWorkerHandle(late, self)
         raise RuntimeError("fork server did not spawn a worker in time")
 
@@ -327,11 +387,19 @@ class WorkerForkServer:
             code = self._exits.get(pid)
         if code is not None:
             return code
-        # exit events come FROM the template; if it died (OOM, crash)
-        # they never arrive — fall back to direct liveness so the
-        # agent's monitor/stop paths cannot wait forever on a pid
-        # that is already gone
-        if self._proc is None or self._proc.poll() is not None:
+        # exit events come FROM the template that forked this pid; if
+        # that template died (OOM, crash) or was closed and REBUILT
+        # (the current live template knows nothing of an older
+        # generation's children) they never arrive — fall back to
+        # direct liveness so the agent's monitor/stop paths cannot
+        # wait forever on a pid that is already gone
+        with self._lock:
+            stale_gen = (
+                self._pid_generation.get(pid, self._generation)
+                != self._generation
+            )
+        if (stale_gen or self._proc is None
+                or self._proc.poll() is not None):
             try:
                 os.kill(pid, 0)
             except ProcessLookupError:

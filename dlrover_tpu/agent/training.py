@@ -71,6 +71,14 @@ class WorkerSpec:
     # jax/flax import cost, the dominant goodput loss under churn
     # (see agent/forkserver.py)
     warm_restart: bool = False
+    # recovery boost: RESPAWNED (restart_count > 0) warm-forked
+    # workers start at this scheduling priority for recovery_boost_s
+    # seconds, so restore + retrace is never starved by host load —
+    # an unbounded recovery under a load spike is what pushes
+    # goodput below target.  0 disables; needs privileges for
+    # negative values (silently unboosted otherwise).
+    recovery_nice: int = -10
+    recovery_boost_s: float = 20.0
 
 
 @dataclass
@@ -290,10 +298,36 @@ class ElasticTrainingAgent:
                 "(interpreter flags / -m); using cold spawns",
                 self._spec.entrypoint,
             )
+        boost = None
+        if self._restart_count > 0 and self._spec.recovery_nice:
+            boost = {
+                "nice": self._spec.recovery_nice,
+                "seconds": self._spec.recovery_boost_s,
+            }
         for local_rank in range(self._spec.nproc_per_node):
             env = self._worker_env(outcome, local_rank)
             if forked_argv is not None:
-                proc = self._forkserver.spawn(forked_argv, env)
+                try:
+                    proc = self._forkserver.spawn(
+                        forked_argv, env, nice_boost=boost
+                    )
+                except RuntimeError as e:
+                    # watchdog: a wedged template must not turn one
+                    # kill into an unbounded recovery — fall back to
+                    # cold spawns for the REST OF THIS ROUND (a
+                    # rebuilt template would likely wedge the same
+                    # way and burn another full timeout per rank);
+                    # the next round's spawn rebuilds the template
+                    logger.warning(
+                        "warm fork timed out (%s); cold-spawning "
+                        "rank %d and the remaining ranks this "
+                        "round", e, local_rank,
+                    )
+                    self._forkserver.close()
+                    forked_argv = None
+                    proc = subprocess.Popen(  # noqa: S603
+                        self._spec.entrypoint, env=env
+                    )
             else:
                 proc = subprocess.Popen(  # noqa: S603 - entrypoint
                     self._spec.entrypoint, env=env
