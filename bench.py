@@ -411,13 +411,14 @@ def bench_xl_act_offload(jax, results: dict):
                     + str(e)[:200]}
 
     seq2, batch2 = 2048, 4
-    results["xl_act_offload"] = {
-        "model": "gpt2_xl",
-        "seq_len": seq2,
-        "batch": batch2,
-        "offload": try_xl(seq2, batch2, "offload"),
-        "plain_remat_control": try_xl(seq2, batch2, "full"),
-    }
+    # filled INCREMENTALLY (the key lands before the legs run): the
+    # section regularly outlives its budget through the tunnel, and
+    # the child's periodic state dump must preserve a completed
+    # offload leg even when the control leg's kill arrives
+    out = {"model": "gpt2_xl", "seq_len": seq2, "batch": batch2}
+    results["xl_act_offload"] = out
+    out["offload"] = try_xl(seq2, batch2, "offload")
+    out["plain_remat_control"] = try_xl(seq2, batch2, "full")
 
 
 def bench_input_pipeline(jax, results: dict):
@@ -2257,7 +2258,7 @@ def main() -> int:
         ("gqa_attention_kernel", 120),
         ("sparse_kv", 100),
         ("input_pipeline", 150),
-        ("xl_act_offload", 300),
+        ("xl_act_offload", 360),
     ]
     for name, budget in sections:
         run_section(name, budget)
