@@ -36,7 +36,11 @@ def main():
     prompts = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (16, PROMPT_LEN), dtype=np.int32
     ))
-    dp = Strategy(opts=[("parallel_mode", {}), ("amp_native", {})])
+    # PER-ROLE strategies (reference ModelEngine accelerates each
+    # model type with its own): the actor declares a layout, the
+    # critic SEARCHES its own (cost-model ranked, chip-free), the
+    # frozen ref could take a sharded inference layout via
+    # RoleSpec(mesh=..., rules=...)
     engine = RLModelEngine(
         sample_rollout_batch(prompts, MAX_NEW),
         {
@@ -44,17 +48,19 @@ def main():
                 model=actor,
                 loss_fn=make_actor_loss(actor, PROMPT_LEN),
                 optim_factory=lambda: optax.adam(5e-3),
-                strategy=dp,
+                strategy=Strategy(opts=[("parallel_mode", {}),
+                                        ("amp_native", {})]),
             ),
             ModelRole.CRITIC: RoleSpec(
                 model=critic,
                 loss_fn=make_critic_loss(critic, PROMPT_LEN),
                 optim_factory=lambda: optax.adam(1e-3),
-                strategy=dp,
+                search=True, rank_mode="cost_model",
             ),
             ModelRole.REF: RoleSpec(model=actor, params=ref_params),
         },
     ).build()
+    print("role report:", engine.role_report())
 
     def reward_fn(sequences):  # favor low token ids
         resp = sequences[:, PROMPT_LEN:]
