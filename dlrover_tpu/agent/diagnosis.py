@@ -110,6 +110,41 @@ class ChipMetricsCollector(DataCollector):
             return f"chip metrics unavailable: {e}"
 
 
+class StepTimeCollector(DataCollector):
+    """Per-step wall time derived from the trainer's metrics file
+    (successive polls: delta timestamp / delta step).  The master's
+    straggler operator compares these ACROSS nodes — the reference's
+    >2x-median rule needs a per-node step-duration signal."""
+
+    data_type = "step_time"
+
+    def __init__(self, metrics_path: Optional[str] = None):
+        from dlrover_tpu.agent.monitor import TrainingMonitor
+
+        self._path = (
+            metrics_path or TrainingMonitor.default_metrics_path()
+        )
+        self._last: Optional[tuple] = None  # (step, timestamp)
+
+    def collect(self) -> str:
+        import json
+        import os
+
+        try:
+            if not os.path.exists(self._path):
+                return ""
+            with open(self._path) as f:
+                record = json.load(f)
+            step = int(record.get("global_step", -1))
+            ts = float(record.get("timestamp", 0.0))
+        except (OSError, ValueError):
+            return ""
+        prev, self._last = self._last, (step, ts)
+        if prev and step > prev[0] and ts > prev[1]:
+            return f"{(ts - prev[1]) / (step - prev[0]):.4f}"
+        return ""  # no progress between polls: nothing to report
+
+
 class DiagnosisMonitor:
     """Periodic collection + report loop (reference:
     diagnosis.py:37,106)."""
@@ -123,6 +158,7 @@ class DiagnosisMonitor:
         self._collectors = collectors if collectors is not None else [
             StackCollector(),
             ChipMetricsCollector(),
+            StepTimeCollector(),
         ]
         self._interval = interval
         self._client = client or MasterClient.singleton()

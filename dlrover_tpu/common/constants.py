@@ -87,6 +87,7 @@ class ErrorMonitorConstants:
     TYPE_ERROR = "error"
     ACTION_RELAUNCH = "relaunch"
     ACTION_ABORT = "abort"
+    ACTION_ISOLATE = "isolate"
     ACTION_NONE = "none"
 
 

@@ -257,7 +257,7 @@ class NodeFailure(Message):
 @dataclass
 class DiagnosisData(Message):
     node_id: int = 0
-    data_type: str = ""  # "stack" | "log" | "chip_metrics"
+    data_type: str = ""  # "stack" | "log" | "chip_metrics" | "step_time"
     content: str = ""
     timestamp: float = 0.0
 

@@ -2,15 +2,25 @@
 
 Reference: the master's hang/fault decision logic spread across
 ``dist_master.py:242-248`` (all_running_node_hanged), the error
-monitor, and the diagnosis data collected from agents
-(``elastic_agent/monitor/diagnosis.py``).  The manager keeps a rolling
-window of per-node diagnosis data and answers: is the job hung, which
-node is the likely culprit, what action should the master take.
+monitor, the diagnosis data collected from agents
+(``elastic_agent/monitor/diagnosis.py``), and the INFERENCE CHAIN
+machinery (``master/diagnosis/inferencechain/inference_chain.py:28``
++ ``common.py`` + ``operator/check_training_hang_operator.py``): a
+problem is an :class:`Inference`; registered operators expand
+compatible inferences into more specific ones; the chain iterates to
+a fixpoint, so a "is training hung?" problem becomes "training IS
+hung" becomes "node 3 blocks a collective" becomes "relaunch".
+
+The manager keeps a rolling window of per-node diagnosis data and
+answers through the chain: is the job hung or dragged by a straggler,
+which node is the culprit, what action should the master take.
 """
 
+import statistics
 import time
+from abc import ABC, abstractmethod
 from collections import defaultdict, deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import ErrorMonitorConstants
@@ -24,12 +34,231 @@ class Diagnosis:
     culprit_node: int = -1
     action: str = ErrorMonitorConstants.ACTION_NONE
     reason: str = ""
+    # the full conclusion set the chain reached (back-compat callers
+    # can ignore it)
+    inferences: List["Inference"] = field(default_factory=list)
+
+
+# -- inference chain ---------------------------------------------------------
+
+
+class InferName:
+    TRAINING = "training"
+    NODE = "node"
+    JOB = "job"
+
+
+class InferAttr:
+    IS_OR_NOT = "is_or_not"   # an open QUESTION
+    IS = "is"                 # an established FACT
+    CAUSE = "cause"
+    ACTION = "action"
+
+
+@dataclass(frozen=True)
+class Inference:
+    """One problem/fact/conclusion in the chain (reference:
+    ``inferencechain/common.py`` Inference).  Identity is the
+    (name, attribution, description) triple; ``detail`` carries
+    free-form evidence and is excluded from equality so two
+    operators reaching the same conclusion with different wording
+    deduplicate."""
+
+    name: str
+    attribution: str
+    description: str
+    detail: str = field(default="", compare=False)
+
+
+class InferenceOperator(ABC):
+    """Expands a compatible inference into more specific ones
+    (reference: ``inferencechain/common.py`` InferenceOperator).
+    Returning ``[]`` means "no progress" — the chain keeps the
+    original inference."""
+
+    @abstractmethod
+    def is_compatible(self, inference: Inference) -> bool:
+        ...
+
+    @abstractmethod
+    def infer(self, inference: Inference, ctx: "DiagnosisContext"
+              ) -> List[Inference]:
+        ...
+
+
+@dataclass
+class DiagnosisContext:
+    """What operators read: the windowed per-node data and the
+    master's speed monitor."""
+
+    manager: "DiagnosisManager"
+    speed_monitor: object = None
+    hang_timeout: float = 1800.0
+    straggler_ratio: float = 2.0
+
+
+class InferenceChain:
+    """Iterate operators over the inference set to a fixpoint
+    (reference: ``inference_chain.py:37`` infer loop).  Bounded: a
+    pathological operator pair cannot loop forever."""
+
+    def __init__(self, operators: List[InferenceOperator],
+                 max_rounds: int = 8):
+        self._operators = operators
+        self._max_rounds = max_rounds
+
+    def infer(self, problems: List[Inference],
+              ctx: DiagnosisContext) -> List[Inference]:
+        inferences = list(problems)
+        for _ in range(self._max_rounds):
+            nxt: List[Inference] = []
+            for inf in inferences:
+                out: List[Inference] = []
+                for op in self._operators:
+                    if not op.is_compatible(inf):
+                        continue
+                    try:
+                        out = op.infer(inf, ctx)
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            "diagnosis operator %s failed: %s",
+                            type(op).__name__, e,
+                        )
+                        out = []
+                    if out:
+                        break
+                for o in (out or [inf]):
+                    if o not in nxt:
+                        nxt.append(o)
+            # fixpoint on SET membership: an operator that re-emits
+            # its input alongside new facts converges instead of
+            # "progressing" every round until the bound
+            if set(nxt) == set(inferences):
+                break
+            inferences = nxt
+        return inferences
+
+
+class HangCheckOperator(InferenceOperator):
+    """"Is training hung?" -> the fact, from the speed monitor's
+    last-step timeline (reference:
+    ``operator/check_training_hang_operator.py``)."""
+
+    def is_compatible(self, inf: Inference) -> bool:
+        return (inf.name == InferName.TRAINING
+                and inf.attribution == InferAttr.IS_OR_NOT
+                and inf.description == "hang")
+
+    def infer(self, inf, ctx):
+        sm = ctx.speed_monitor
+        if sm is None:
+            return []
+        # the guarded predicate: no verdict unless workers are
+        # REGISTERED and have STEPPED at least once — a long startup
+        # (scheduling, cold compile, restore) must not read as a hang
+        if sm.all_worker_hanged(ctx.hang_timeout):
+            stall = time.time() - sm.last_step_time
+            return [Inference(
+                InferName.TRAINING, InferAttr.IS, "hang",
+                detail=f"no step for {stall:.0f}s",
+            )]
+        return []
+
+
+class HangCulpritOperator(InferenceOperator):
+    """"Training IS hung" -> which node blocks, from the latest
+    per-node stacks (blocked collective / D-state heuristic)."""
+
+    def is_compatible(self, inf: Inference) -> bool:
+        return (inf.name == InferName.TRAINING
+                and inf.attribution == InferAttr.IS
+                and inf.description == "hang")
+
+    def infer(self, inf, ctx):
+        culprit = ctx.manager._find_stuck_node()
+        if culprit < 0:
+            return []  # keep the hang fact; resolution handles it
+        return [
+            inf,
+            Inference(
+                InferName.NODE, InferAttr.CAUSE,
+                "blocked_collective", detail=str(culprit),
+            ),
+        ]
+
+
+class StragglerCheckOperator(InferenceOperator):
+    """"Is a straggler dragging the job?" -> the culprit node, from
+    per-node reported step times (the reference's >2x-median rule,
+    ``master/elastic_training/rdzv_manager.py:550-565``)."""
+
+    def is_compatible(self, inf: Inference) -> bool:
+        return (inf.name == InferName.TRAINING
+                and inf.attribution == InferAttr.IS_OR_NOT
+                and inf.description == "straggler")
+
+    def infer(self, inf, ctx):
+        per_node: Dict[int, float] = {}
+        for node_id, datas in ctx.manager._data.items():
+            times = [
+                float(d.content) for d in datas
+                if d.data_type == "step_time"
+            ]
+            if times:
+                per_node[node_id] = statistics.median(times)
+        if len(per_node) < 2:
+            return []
+        med = statistics.median(per_node.values())
+        worst_id, worst = max(per_node.items(), key=lambda kv: kv[1])
+        if med > 0 and worst > ctx.straggler_ratio * med:
+            return [Inference(
+                InferName.NODE, InferAttr.CAUSE, "straggler",
+                detail=f"{worst_id}:{worst:.2f}s vs median {med:.2f}s",
+            )]
+        return []
+
+
+class ResolutionOperator(InferenceOperator):
+    """Node-cause facts -> the master's action (reference: the
+    Diagnostician's resolution step)."""
+
+    def is_compatible(self, inf: Inference) -> bool:
+        return (inf.name == InferName.NODE
+                and inf.attribution == InferAttr.CAUSE)
+
+    def infer(self, inf, ctx):
+        action = (
+            ErrorMonitorConstants.ACTION_ISOLATE
+            if inf.description == "straggler"
+            else ErrorMonitorConstants.ACTION_RELAUNCH
+        )
+        return [
+            inf,
+            Inference(
+                InferName.JOB, InferAttr.ACTION, action,
+                detail=inf.detail,
+            ),
+        ]
+
+
+def default_operators() -> List[InferenceOperator]:
+    return [
+        HangCheckOperator(),
+        HangCulpritOperator(),
+        StragglerCheckOperator(),
+        ResolutionOperator(),
+    ]
 
 
 class DiagnosisManager:
-    def __init__(self, window: int = 20):
+    def __init__(self, window: int = 20,
+                 operators: Optional[List[InferenceOperator]] = None):
         self._data: Dict[int, Deque[DiagnosisData]] = defaultdict(
             lambda: deque(maxlen=window)
+        )
+        self._chain = InferenceChain(
+            operators if operators is not None
+            else default_operators()
         )
 
     def collect(self, data: DiagnosisData):
@@ -42,26 +271,68 @@ class DiagnosisManager:
         self,
         speed_monitor,
         hang_timeout: float = 1800.0,
+        straggler_ratio: float = 2.0,
     ) -> Diagnosis:
-        """Combine throughput stall + stack evidence into a verdict
-        (reference: all_running_node_hanged + task_hanged checks)."""
-        last = speed_monitor.last_step_time  # property
-        if last and time.time() - last > hang_timeout:
-            culprit = self._find_stuck_node()
-            return Diagnosis(
-                hung=True,
-                culprit_node=culprit,
-                action=ErrorMonitorConstants.ACTION_RELAUNCH,
-                reason=(
-                    f"no step for {time.time() - last:.0f}s; "
-                    + (
-                        f"node {culprit} stacks show blocked collective"
-                        if culprit >= 0
-                        else "no single culprit identified"
+        """Run the inference chain over the standing problems
+        ("is training hung?", "is a straggler dragging it?") and fold
+        the conclusions into the legacy verdict shape (reference:
+        DiagnosisManager.start seeds the chain with the hang problem,
+        ``master/diagnosis/diagnosis.py:40``)."""
+        ctx = DiagnosisContext(
+            manager=self, speed_monitor=speed_monitor,
+            hang_timeout=hang_timeout,
+            straggler_ratio=straggler_ratio,
+        )
+        problems = [
+            Inference(InferName.TRAINING, InferAttr.IS_OR_NOT, "hang"),
+            Inference(
+                InferName.TRAINING, InferAttr.IS_OR_NOT, "straggler"
+            ),
+        ]
+        conclusions = self._chain.infer(problems, ctx)
+        verdict = Diagnosis(inferences=conclusions)
+        reasons: List[str] = []
+        actions = set()
+        causes: Dict[str, int] = {}
+        for c in conclusions:
+            if (c.name == InferName.TRAINING
+                    and c.attribution == InferAttr.IS
+                    and c.description == "hang"):
+                verdict.hung = True
+                reasons.append(c.detail or "training hung")
+                # a hang with no identified culprit still demands a
+                # relaunch (legacy contract)
+                actions.add(ErrorMonitorConstants.ACTION_RELAUNCH)
+            elif (c.name == InferName.NODE
+                    and c.attribution == InferAttr.CAUSE):
+                try:
+                    causes[c.description] = int(
+                        c.detail.split(":")[0]
                     )
-                ),
-            )
-        return Diagnosis()
+                except ValueError:
+                    pass
+                reasons.append(f"node cause {c.description}: "
+                               f"{c.detail}")
+            elif (c.name == InferName.JOB
+                    and c.attribution == InferAttr.ACTION):
+                actions.add(c.description)
+        # culprit precedence mirrors action severity: the node
+        # blocking a collective (the hang's cause) outranks a
+        # straggler that merely slows the job
+        for cause in ("blocked_collective", "straggler"):
+            if cause in causes:
+                verdict.culprit_node = causes[cause]
+                break
+        # severity order: a hang's relaunch outranks a straggler's
+        # isolate; abort outranks both
+        for a in (ErrorMonitorConstants.ACTION_ABORT,
+                  ErrorMonitorConstants.ACTION_RELAUNCH,
+                  ErrorMonitorConstants.ACTION_ISOLATE):
+            if a in actions:
+                verdict.action = a
+                break
+        verdict.reason = "; ".join(reasons)
+        return verdict
 
     def _find_stuck_node(self) -> int:
         """Heuristic: the node whose latest stack shows a blocking

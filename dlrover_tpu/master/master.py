@@ -15,9 +15,14 @@ import time
 from typing import Optional
 
 from dlrover_tpu.common.comm import MessageServer, find_free_port
-from dlrover_tpu.common.constants import JobExitReason, RendezvousName
+from dlrover_tpu.common.constants import (
+    ErrorMonitorConstants,
+    JobExitReason,
+    RendezvousName,
+)
 from dlrover_tpu.common.global_context import Context
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.diagnosis import DiagnosisManager
 from dlrover_tpu.master.job_manager import JobManager
 from dlrover_tpu.master.kv_store import KVStoreService
 from dlrover_tpu.master.rdzv_manager import (
@@ -41,6 +46,8 @@ class JobMaster:
         self.job_name = job_name
         self.node_num = node_num
         self.speed_monitor = SpeedMonitor()
+        self.diagnosis_manager = DiagnosisManager()
+        self._last_straggler_warned = -1
         # platform-backed masters inject a DistributedJobManager
         # (node watching/scaling); local mode uses the plain one
         self.job_manager = job_manager or JobManager()
@@ -130,13 +137,40 @@ class JobMaster:
                         )
                         self._exit_code = 1
                     break
-                if self.speed_monitor.all_worker_hanged(ctx.hang_timeout):
-                    logger.error("all workers hanged; stopping job")
+                # inference-chain diagnosis over the agents' reported
+                # evidence (stacks, logs, per-node step times) — the
+                # hang verdict replaces the blunt last-step check
+                # with a reasoned one (culprit + action), and a
+                # straggler conclusion is surfaced even while steps
+                # still complete
+                for rec in self.servicer.drain_diagnosis_records():
+                    self.diagnosis_manager.collect(rec)
+                verdict = self.diagnosis_manager.diagnose(
+                    self.speed_monitor, hang_timeout=ctx.hang_timeout
+                )
+                if verdict.hung:
+                    logger.error(
+                        "training hung; stopping job (%s)",
+                        verdict.reason,
+                    )
                     self.job_manager.job_exit_reason = (
                         JobExitReason.HANG_ERROR
                     )
                     self._exit_code = 1
                     break
+                if (verdict.action
+                        == ErrorMonitorConstants.ACTION_ISOLATE
+                        and verdict.culprit_node
+                        != self._last_straggler_warned):
+                    # once per distinct culprit, not once per poll
+                    self._last_straggler_warned = (
+                        verdict.culprit_node
+                    )
+                    logger.warning(
+                        "straggler diagnosis: %s (isolation happens "
+                        "through the next rendezvous round's "
+                        "straggler rule)", verdict.reason,
+                    )
                 if self.task_manager.finished():
                     logger.info("all dataset tasks completed")
                     break

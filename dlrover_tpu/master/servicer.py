@@ -239,6 +239,13 @@ class MasterServicer(RequestHandler):
         logger.warning("unhandled report message %s", type(message).__name__)
         return False
 
+    def drain_diagnosis_records(self):
+        """Hand the accumulated agent diagnosis reports to the
+        master's inference-chain manager (report() runs on server
+        threads; the atomic swap keeps the hand-off race-free)."""
+        records, self.diagnosis_records = self.diagnosis_records, []
+        return records
+
     @property
     def exit_requested(self) -> str:
         return self._exit_reason

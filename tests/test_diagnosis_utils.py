@@ -48,6 +48,7 @@ def test_diagnosis_manager_finds_culprit():
         content="worker pid 7: state=D wchan=futex_wait barrier",
     ))
     sm = SpeedMonitor()
+    sm.add_running_worker(0)
     sm.collect_global_step(5, time.time() - 4000)
     verdict = mgr.diagnose(sm, hang_timeout=1800)
     assert verdict.hung
@@ -133,3 +134,139 @@ def test_comm_perf_check_reports_bandwidth():
     assert report["devices"] == 8
     assert report["algbw_gbps"] > 0
     assert report["busbw_gbps"] > report["algbw_gbps"]
+
+
+def test_inference_chain_reaches_fixpoint_with_dedup():
+    """The chain expands problems through operators to a stable
+    conclusion set (reference: inference_chain.py infer loop)."""
+    from dlrover_tpu.master.diagnosis import (
+        DiagnosisContext,
+        DiagnosisManager,
+        InferAttr,
+        Inference,
+        InferenceChain,
+        InferenceOperator,
+        InferName,
+    )
+
+    class AtoB(InferenceOperator):
+        def is_compatible(self, inf):
+            return inf.description == "a"
+
+        def infer(self, inf, ctx):
+            return [Inference("x", InferAttr.IS, "b", detail="from-a")]
+
+    class BtoSelfPlusC(InferenceOperator):
+        """Re-emits its input alongside a new fact — must converge,
+        not spin to the round bound."""
+
+        def is_compatible(self, inf):
+            return inf.description == "b"
+
+        def infer(self, inf, ctx):
+            return [inf, Inference("x", InferAttr.IS, "c")]
+
+    chain = InferenceChain([AtoB(), BtoSelfPlusC()])
+    ctx = DiagnosisContext(manager=DiagnosisManager())
+    out = chain.infer(
+        [Inference("x", InferAttr.IS_OR_NOT, "a")], ctx
+    )
+    descs = sorted(i.description for i in out)
+    assert descs == ["b", "c"]
+
+
+def test_straggler_operator_isolates_slow_node():
+    from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+    mgr = DiagnosisManager()
+    for node, step_s in ((0, 1.0), (1, 1.1), (2, 1.0), (3, 4.8)):
+        for _ in range(4):
+            mgr.collect(DiagnosisData(
+                node_id=node, data_type="step_time",
+                content=str(step_s),
+            ))
+    sm = SpeedMonitor()
+    sm.collect_global_step(5, time.time())  # stepping: not hung
+    verdict = mgr.diagnose(sm)
+    assert not verdict.hung
+    assert verdict.culprit_node == 3
+    assert verdict.action == "isolate"
+    assert "straggler" in verdict.reason
+
+
+def test_hang_outranks_straggler_action():
+    from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+    mgr = DiagnosisManager()
+    for node, step_s in ((0, 1.0), (1, 1.0), (2, 5.5)):
+        for _ in range(3):
+            mgr.collect(DiagnosisData(
+                node_id=node, data_type="step_time",
+                content=str(step_s),
+            ))
+    mgr.collect(DiagnosisData(
+        node_id=2, data_type="stack",
+        content="state=D wchan=futex barrier allreduce",
+    ))
+    sm = SpeedMonitor()
+    sm.add_running_worker(0)
+    sm.collect_global_step(5, time.time() - 4000)  # stalled
+    verdict = mgr.diagnose(sm, hang_timeout=1800)
+    assert verdict.hung
+    assert verdict.action == "relaunch"  # outranks isolate
+    assert verdict.culprit_node == 2
+
+
+def test_chain_survives_broken_operator():
+    from dlrover_tpu.master.diagnosis import (
+        DiagnosisContext,
+        DiagnosisManager,
+        InferAttr,
+        Inference,
+        InferenceChain,
+        InferenceOperator,
+    )
+
+    class Broken(InferenceOperator):
+        def is_compatible(self, inf):
+            return True
+
+        def infer(self, inf, ctx):
+            raise RuntimeError("boom")
+
+    chain = InferenceChain([Broken()])
+    ctx = DiagnosisContext(manager=DiagnosisManager())
+    problem = Inference("x", InferAttr.IS_OR_NOT, "a")
+    assert chain.infer([problem], ctx) == [problem]
+
+
+def test_no_hang_verdict_before_first_step():
+    """A long startup (scheduling, cold compile, restore) must not
+    read as a hang: the guard requires registered workers AND at
+    least one reported step."""
+    from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+    mgr = DiagnosisManager()
+    sm = SpeedMonitor()  # last_step_time set at construction...
+    sm._start_time = sm._last_step_time = time.time() - 4000
+    # ...but no workers registered, no samples: not a hang
+    assert not mgr.diagnose(sm, hang_timeout=1800).hung
+
+
+def test_step_time_collector_reports_delta(tmp_path):
+    import json as _json
+
+    from dlrover_tpu.agent.diagnosis import StepTimeCollector
+
+    path = tmp_path / "metrics.json"
+    col = StepTimeCollector(str(path))
+    assert col.collect() == ""  # no file yet
+    path.write_text(_json.dumps(
+        {"global_step": 10, "timestamp": 1000.0}
+    ))
+    assert col.collect() == ""  # first observation: no delta yet
+    path.write_text(_json.dumps(
+        {"global_step": 14, "timestamp": 1006.0}
+    ))
+    assert col.collect() == "1.5000"  # 6s over 4 steps
+    assert col.collect() == ""  # no progress since

@@ -409,3 +409,43 @@ def test_topology_sorted_rendezvous_world():
     assert outcome.base_rank(1) == 4
     assert outcome.base_rank(0) == 8
     assert outcome.base_rank(2) == 12
+
+
+def test_master_loop_diagnoses_hang_with_culprit(local_master):
+    """The run loop drains agent diagnosis reports through the
+    inference chain: a stalled step timeline + a blocked-collective
+    stack from one node exits with HANG_ERROR and the verdict names
+    the culprit (reference: the master's all_running_node_hanged
+    check upgraded to the diagnosis chain)."""
+    from dlrover_tpu.common.constants import JobExitReason
+    from dlrover_tpu.common.global_context import Context
+    from dlrover_tpu.common.messages import DiagnosisData
+
+    master = local_master
+    # a worker reported steps long ago, then stalled
+    master.speed_monitor.add_running_worker(0)
+    master.speed_monitor.collect_global_step(5, time.time() - 4000)
+    # agent-side evidence arrives through the REAL report path
+    client = _client(master, node_id=1)
+    client.report(DiagnosisData(
+        node_id=1, data_type="stack",
+        content="state=D wchan=futex barrier allreduce",
+    ))
+    ctx = Context.instance()
+    old_poll, old_hang = ctx.seconds_to_check_hang, ctx.hang_timeout
+    ctx.seconds_to_check_hang = 0.2
+    ctx.hang_timeout = 60.0
+    try:
+        rc = master.run()
+    finally:
+        ctx.seconds_to_check_hang = old_poll
+        ctx.hang_timeout = old_hang
+    assert rc == 1
+    assert master.job_manager.job_exit_reason == (
+        JobExitReason.HANG_ERROR
+    )
+    # the chain identified the culprit from the reported stack
+    verdict = master.diagnosis_manager.diagnose(
+        master.speed_monitor, hang_timeout=60.0
+    )
+    assert verdict.hung and verdict.culprit_node == 1
