@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from dlrover_tpu.common.jax_compat import cost_analysis
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -128,7 +129,7 @@ def estimate_plan(plan, context, devices=None) -> DryRunResult:
         return DryRunResult(ok=False, error=str(e))
 
     try:
-        cost = compiled.cost_analysis() or {}
+        cost = cost_analysis(compiled)
         flops = float(cost.get("flops", 0.0))
         bytes_accessed = float(cost.get("bytes accessed", 0.0))
         dev = built.mesh.devices.flat[0]

@@ -233,9 +233,17 @@ class ForkedWorkerHandle:
     def __init__(self, pid: int, server: "WorkerForkServer"):
         self.pid = pid
         self._server = server
+        self._code: Optional[int] = None
 
     def poll(self) -> Optional[int]:
-        return self._server.exit_code(self.pid)
+        # cache the code here and CONSUME the server-side entry: the
+        # handle is the only owner of this pid, so once the code is
+        # local the server's per-pid bookkeeping can be pruned (a
+        # long-lived elastic agent respawns workers for the life of
+        # the job and must not accumulate an entry per incarnation)
+        if self._code is None:
+            self._code = self._server.consume_exit(self.pid)
+        return self._code
 
     def wait(self, timeout: Optional[float] = None) -> int:
         deadline = None if timeout is None else time.time() + timeout
@@ -281,6 +289,11 @@ class WorkerForkServer:
         # template is gone (close + rebuild), liveness must be
         # probed directly or the handle polls None forever
         self._pid_generation: Dict[int, int] = {}
+        # kernel start time recorded at spawn: (pid, start_time) is
+        # unique across pid recycling, so the liveness fallback can
+        # tell "our worker" from an unrelated process that inherited
+        # the number after wraparound
+        self._pid_start: Dict[int, Optional[int]] = {}
         self._generation = 0
         self._next_req = 0
         self._lock = threading.Lock()
@@ -364,8 +377,7 @@ class WorkerForkServer:
             with self._lock:
                 pid = self._spawn_results.pop(req_id, None)
             if pid is not None:
-                with self._lock:
-                    self._pid_generation[pid] = self._generation
+                self._register_pid(pid)
                 return ForkedWorkerHandle(pid, self)
             time.sleep(0.01)
         with self._lock:
@@ -377,10 +389,29 @@ class WorkerForkServer:
             if late is None:
                 self._abandoned.add(req_id)
         if late is not None:  # landed between the last poll and now
-            with self._lock:
-                self._pid_generation[late] = self._generation
+            self._register_pid(late)
             return ForkedWorkerHandle(late, self)
         raise RuntimeError("fork server did not spawn a worker in time")
+
+    def _register_pid(self, pid: int):
+        start = self._proc_start_time(pid)
+        with self._lock:
+            self._pid_generation[pid] = self._generation
+            self._pid_start[pid] = start
+
+    @staticmethod
+    def _proc_start_time(pid: int) -> Optional[int]:
+        """Kernel start time of ``pid`` (/proc/<pid>/stat field 22,
+        clock ticks since boot); None when the pid is gone.  comm
+        (field 2) may itself contain spaces or ')', so fields are
+        parsed after the LAST ')'."""
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                data = f.read()
+            rest = data.rsplit(b")", 1)[1].split()
+            return int(rest[19])
+        except (OSError, IndexError, ValueError):
+            return None
 
     def exit_code(self, pid: int) -> Optional[int]:
         with self._lock:
@@ -398,17 +429,40 @@ class WorkerForkServer:
                 self._pid_generation.get(pid, self._generation)
                 != self._generation
             )
+            spawn_start = self._pid_start.get(pid)
         if (stale_gen or self._proc is None
                 or self._proc.poll() is not None):
-            try:
-                os.kill(pid, 0)
-            except ProcessLookupError:
+            # liveness probe guarded against pid recycling: a bare
+            # kill(pid, 0) says "some process with this number
+            # exists" — after pid wraparound that can be a stranger,
+            # and the agent would wait on it forever.  The kernel
+            # start time recorded at spawn disambiguates: same pid +
+            # different start time means OUR worker exited.
+            now_start = self._proc_start_time(pid)
+            alive = now_start is not None and (
+                spawn_start is None or now_start == spawn_start
+            )
+            if not alive:
                 with self._lock:
                     self._exits[pid] = -1
                 return -1
-            except PermissionError:
-                pass
         return None
+
+    def consume_exit(self, pid: int) -> Optional[int]:
+        """``exit_code`` that prunes the pid's bookkeeping once a
+        code is returned, so entries do not grow unbounded across
+        respawn rounds."""
+        code = self.exit_code(pid)
+        if code is not None:
+            with self._lock:
+                self._exits.pop(pid, None)
+                self._pid_generation.pop(pid, None)
+                self._pid_start.pop(pid, None)
+                try:
+                    self._spawned.remove(pid)
+                except ValueError:
+                    pass
+        return code
 
     def close(self):
         if self._proc is None:

@@ -17,11 +17,28 @@ from typing import Dict, List, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry.metrics import get_registry
 
 try:
     import psutil
 except ImportError:  # pragma: no cover - psutil is normally present
     psutil = None
+
+_REG = get_registry()
+_REPORT_SECONDS = _REG.histogram(
+    "dlrover_agent_report_seconds",
+    "One monitor report cycle (sample + RPC to the master)",
+)
+_REPORT_ERRORS_TOTAL = _REG.counter(
+    "dlrover_agent_report_errors_total",
+    "Monitor report cycles that failed",
+)
+_HOST_CPU_GAUGE = _REG.gauge(
+    "dlrover_host_cpu_percent", "Host CPU utilization sampled by the agent"
+)
+_HOST_MEM_GAUGE = _REG.gauge(
+    "dlrover_host_memory_mb", "Host memory in use sampled by the agent"
+)
 
 
 def get_host_stats() -> Dict[str, float]:
@@ -78,13 +95,17 @@ class ResourceMonitor:
     def _run(self):
         while not self._stopped.wait(self._interval):
             try:
-                stats = get_host_stats()
-                self._client.report_resource_stats(
-                    cpu_percent=stats["cpu_percent"],
-                    memory_mb=stats["memory_mb"],
-                    chip_stats=get_chip_stats(),
-                )
+                with _REPORT_SECONDS.time(monitor="resource"):
+                    stats = get_host_stats()
+                    _HOST_CPU_GAUGE.set(stats["cpu_percent"])
+                    _HOST_MEM_GAUGE.set(stats["memory_mb"])
+                    self._client.report_resource_stats(
+                        cpu_percent=stats["cpu_percent"],
+                        memory_mb=stats["memory_mb"],
+                        chip_stats=get_chip_stats(),
+                    )
             except Exception as e:  # noqa: BLE001
+                _REPORT_ERRORS_TOTAL.inc(monitor="resource")
                 logger.warning("resource report failed: %s", e)
 
     def stop(self):
@@ -133,16 +154,18 @@ class TrainingMonitor:
         try:
             if not os.path.exists(self._path):
                 return
-            with open(self._path) as f:
-                record = json.load(f)
-            step = int(record.get("global_step", -1))
-            ts = float(record.get("timestamp", time.time()))
-            if step > self._last_step:
-                self._client.report_global_step(step, ts)
-                self._last_step = step
+            with _REPORT_SECONDS.time(monitor="training"):
+                with open(self._path) as f:
+                    record = json.load(f)
+                step = int(record.get("global_step", -1))
+                ts = float(record.get("timestamp", time.time()))
+                if step > self._last_step:
+                    self._client.report_global_step(step, ts)
+                    self._last_step = step
         except (OSError, ValueError) as e:
             logger.debug("metrics file read failed: %s", e)
         except Exception as e:  # noqa: BLE001
+            _REPORT_ERRORS_TOTAL.inc(monitor="training")
             logger.warning("global-step report failed: %s", e)
 
     def stop(self):
@@ -172,8 +195,10 @@ class HeartbeatReporter:
     def _run(self):
         while not self._stopped.wait(self._interval):
             try:
-                self.last_action = self._client.report_heartbeat()
+                with _REPORT_SECONDS.time(monitor="heartbeat"):
+                    self.last_action = self._client.report_heartbeat()
             except Exception as e:  # noqa: BLE001
+                _REPORT_ERRORS_TOTAL.inc(monitor="heartbeat")
                 logger.warning("heartbeat failed: %s", e)
 
     def stop(self):

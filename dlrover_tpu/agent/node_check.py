@@ -30,6 +30,20 @@ import numpy as np
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import tracing as trace
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+from dlrover_tpu.common.jax_compat import shard_map
+
+_REG = get_registry()
+_CHECK_SECONDS = _REG.histogram(
+    "dlrover_node_check_seconds",
+    "Per-node health-check work time (barrier waits excluded)",
+)
+_BARRIER_SECONDS = _REG.histogram(
+    "dlrover_node_check_barrier_seconds",
+    "Node-check barrier wait (dead/slow-peer indicator)",
+)
 
 
 def mock_error():
@@ -116,7 +130,7 @@ def bm_collective_probe(
         return jax.lax.ppermute(s, "probe", perm)  # neighbor links
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh, in_specs=P("probe"),
             out_specs=P("probe"),
         )
@@ -162,7 +176,7 @@ def comm_perf_check(
         return jax.lax.psum(block, "probe") / n
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh, in_specs=P("probe"),
             out_specs=P("probe"),
         )
@@ -209,7 +223,9 @@ def bm_sync_barrier(
     deadline = time.time() + timeout
     while time.time() < deadline:
         if client.kv_store_add(key, 0) >= world_size:
-            return time.perf_counter() - start
+            wait = time.perf_counter() - start
+            _BARRIER_SECONDS.observe(wait)
+            return wait
         time.sleep(0.1)
     raise TimeoutError(f"node-check barrier round {round_id} timed out")
 
@@ -226,6 +242,18 @@ def run_node_check(
     reports abnormal status.
     """
     client = client or MasterClient.singleton()
+    node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+    with trace.span(
+        "node_check", round=round_id, node_rank=node_rank
+    ) as check_span:
+        return _run_node_check(
+            client, matmul_size, world_size, round_id, check_span
+        )
+
+
+def _run_node_check(
+    client, matmul_size, world_size, round_id, check_span
+) -> float:
     mock_error()
     if world_size > 1:
         # ENTRY barrier: align the start of the timed work phase so a
@@ -253,5 +281,11 @@ def run_node_check(
         # when a peer is dead
         wait = bm_sync_barrier(client, round_id, world_size)
         logger.info("exit barrier wait %.3fs (not counted)", wait)
+    _CHECK_SECONDS.observe(elapsed)
+    check_span.set_attribute("elapsed_s", round(elapsed, 4))
+    emit_event(
+        "node_check", round=round_id,
+        elapsed_s=round(elapsed, 4), world_size=world_size,
+    )
     logger.info("node check elapsed %.3fs", elapsed)
     return elapsed

@@ -42,6 +42,27 @@ from dlrover_tpu.common.constants import (
     TrainingExceptionLevel,
 )
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import tracing as trace
+from dlrover_tpu.telemetry.events import (
+    EVENT_SOURCE_ENV,
+    emit_event,
+    set_event_source,
+)
+from dlrover_tpu.telemetry.exporter import (
+    METRICS_TEXTFILE_ENV,
+    TextfileDumper,
+)
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_REG = get_registry()
+_RDZV_SECONDS = _REG.histogram(
+    "dlrover_agent_rdzv_seconds",
+    "Agent-side join-to-world rendezvous latency",
+)
+_RESTARTS_TOTAL = _REG.counter(
+    "dlrover_agent_worker_restarts_total",
+    "Worker restart rounds this agent performed",
+)
 
 
 class WorkerState(Enum):
@@ -126,34 +147,48 @@ class MasterRendezvousHandler:
         self._timeout = timeout
 
     def next_rendezvous(self) -> RendezvousOutcome:
-        rdzv_round = self._client.join_rendezvous(
-            self._node_rank, self._local_world_size, self._name
-        )
-        start = time.time()
-        while True:
-            round_, _group, world, coordinator = self._client.get_comm_world(
-                self._name, self._node_rank
+        # the span context rides the join RPC frame, so the master's
+        # handler-side ``rdzv.join`` span records this span as parent
+        # — the cross-process link tests assert from the event log
+        with trace.span(
+            "rdzv.join", rdzv=self._name, node_rank=self._node_rank
+        ) as join_span:
+            rdzv_round = self._client.join_rendezvous(
+                self._node_rank, self._local_world_size, self._name
             )
-            if world:
-                if self._node_rank not in world:
-                    raise RuntimeError(
-                        f"node {self._node_rank} excluded from rendezvous "
-                        f"round {round_} world {sorted(world)}"
+            start = time.time()
+            while True:
+                round_, _group, world, coordinator = (
+                    self._client.get_comm_world(
+                        self._name, self._node_rank
                     )
-                logger.info(
-                    "rendezvous %s round %s complete: %s nodes, "
-                    "coordinator %s",
-                    self._name, round_, len(world), coordinator,
                 )
-                return RendezvousOutcome(
-                    round=round_, world=world, coordinator=coordinator
-                )
-            if time.time() - start > self._timeout:
-                raise TimeoutError(
-                    f"rendezvous {self._name} round {rdzv_round} timed out "
-                    f"after {self._timeout}s"
-                )
-            time.sleep(RendezvousConstant.JOIN_INTERVAL)
+                if world:
+                    if self._node_rank not in world:
+                        raise RuntimeError(
+                            f"node {self._node_rank} excluded from "
+                            f"rendezvous round {round_} world "
+                            f"{sorted(world)}"
+                        )
+                    logger.info(
+                        "rendezvous %s round %s complete: %s nodes, "
+                        "coordinator %s",
+                        self._name, round_, len(world), coordinator,
+                    )
+                    wait_s = time.time() - start
+                    _RDZV_SECONDS.observe(wait_s, rdzv=self._name)
+                    join_span.set_attribute("round", round_)
+                    join_span.set_attribute("nodes", len(world))
+                    return RendezvousOutcome(
+                        round=round_, world=world,
+                        coordinator=coordinator,
+                    )
+                if time.time() - start > self._timeout:
+                    raise TimeoutError(
+                        f"rendezvous {self._name} round {rdzv_round} "
+                        f"timed out after {self._timeout}s"
+                    )
+                time.sleep(RendezvousConstant.JOIN_INTERVAL)
 
 
 class ElasticTrainingAgent:
@@ -255,6 +290,9 @@ class ElasticTrainingAgent:
         # agent-side; a recompile is seconds)
         for key, val in self._compile_cache_env().items():
             env.setdefault(key, val)
+        # tag the worker's training events even when the entrypoint
+        # never touches telemetry itself
+        env.setdefault(EVENT_SOURCE_ENV, "trainer")
         env.update(
             {
                 NodeEnv.COORDINATOR_ADDR: outcome.coordinator,
@@ -467,6 +505,13 @@ class ElasticTrainingAgent:
     # -- main loop -----------------------------------------------------------
 
     def run(self) -> int:
+        set_event_source("agent")
+        # no stable scrape address under churn: agents export via a
+        # textfile dump when one is configured (node-exporter style)
+        textfile = os.getenv(METRICS_TEXTFILE_ENV, "")
+        dumper = TextfileDumper(textfile) if textfile else None
+        if dumper is not None:
+            dumper.start()
         for m in self._monitors:
             m.start()
         try:
@@ -474,6 +519,8 @@ class ElasticTrainingAgent:
         finally:
             for m in self._monitors:
                 m.stop()
+            if dumper is not None:
+                dumper.stop()
             if self._forkserver is not None:
                 self._forkserver.close()
 
@@ -486,6 +533,12 @@ class ElasticTrainingAgent:
     def _restart_workers(self):
         self._restart_count += 1
         logger.info("restarting workers (restart %s)", self._restart_count)
+        _RESTARTS_TOTAL.inc()
+        emit_event(
+            "worker_restart",
+            node_rank=self._node_rank,
+            restart_count=self._restart_count,
+        )
         self._save_ckpt_at_breakpoint()
         self._stop_workers()
         self._initialize_workers()

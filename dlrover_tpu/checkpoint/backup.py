@@ -15,6 +15,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.jax_compat import shard_map
 
 
 def _to_u8(payload: bytes, size: int) -> np.ndarray:
@@ -82,7 +83,7 @@ def exchange_with_peer(
         jnp.asarray(stacked),
         NamedSharding(mesh, P(axis)),
     )
-    received = jax.shard_map(
+    received = shard_map(
         shard_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
         check_vma=False,
     )(sharded)

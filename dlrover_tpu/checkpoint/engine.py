@@ -38,6 +38,30 @@ from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
 from dlrover_tpu.common.storage import get_checkpoint_storage
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_REG = get_registry()
+_SHM_SAVE_SECONDS = _REG.histogram(
+    "dlrover_checkpoint_shm_save_seconds",
+    "Device->host + shm memcpy time of one flash save (incl. lock)",
+)
+_ASYNC_WRITE_SECONDS = _REG.histogram(
+    "dlrover_checkpoint_async_write_seconds",
+    "Background writer latency from dequeue to shm write done",
+)
+_SAVE_SKIPPED_TOTAL = _REG.counter(
+    "dlrover_checkpoint_save_skipped_total",
+    "Flash saves skipped because the saver/writer was busy",
+)
+_SAVE_ERRORS_TOTAL = _REG.counter(
+    "dlrover_checkpoint_save_errors_total",
+    "Failed async snapshot writes",
+)
+_RESTORE_SECONDS = _REG.histogram(
+    "dlrover_checkpoint_restore_seconds",
+    "Restore latency by tier (shm fast path vs storage)",
+)
 
 
 class CheckpointEngine:
@@ -193,6 +217,7 @@ class CheckpointEngine:
                     "step %s: saver busy persisting; skipping shm save",
                     step,
                 )
+                _SAVE_SKIPPED_TOTAL.inc(reason="saver_busy")
                 return False
             lock_wait = time.perf_counter() - t0
             locked = True
@@ -211,6 +236,13 @@ class CheckpointEngine:
             phases["lock_wait_s"] = round(lock_wait, 3)
             phases["total_s"] = round(time.time() - start + lock_wait, 3)
             self.last_save_phases = phases
+            _SHM_SAVE_SECONDS.observe(phases["total_s"])
+            emit_event(
+                "checkpoint_shm_save",
+                step=step,
+                rank=self._rank,
+                **{k: v for k, v in phases.items()},
+            )
             logger.info(
                 "rank %s shm save of step %s took %.3fs "
                 "(lock %.2fs, d2h fetch %.2fs, memcpy %.2fs)",
@@ -284,9 +316,10 @@ class CheckpointEngine:
                 return
             step, snap, path, enqueue = item
             try:
-                ok = self.save_to_memory(
-                    step, snap, path, block_lock=True
-                )
+                with _ASYNC_WRITE_SECONDS.time():
+                    ok = self.save_to_memory(
+                        step, snap, path, block_lock=True
+                    )
                 if ok and enqueue and self._event_queue is not None:
                     self._event_queue.put(
                         CheckpointEvent(
@@ -295,6 +328,7 @@ class CheckpointEngine:
                     )
             except Exception as e:  # noqa: BLE001
                 self._last_async_error = e
+                _SAVE_ERRORS_TOTAL.inc()
                 logger.exception(
                     "async snapshot of step %s failed", step
                 )
@@ -331,6 +365,7 @@ class CheckpointEngine:
                     "step %s: previous snapshot still writing; "
                     "skipping save", step,
                 )
+                _SAVE_SKIPPED_TOTAL.inc(reason="writer_busy")
                 return False
             snap = self._device_snapshot(state_dict)
             # kick off the device->host transfers without blocking
@@ -357,11 +392,28 @@ class CheckpointEngine:
     def load(self) -> Tuple[Optional[int], Any]:
         """Restore: shm snapshot if present (fast path after process
         restart), else storage via the tracker file."""
+        t0 = time.perf_counter()
         config, state = self.get_state_dict_from_memory()
         if config is not None:
             logger.info("restored step %s from shared memory", config.step)
+            _RESTORE_SECONDS.observe(
+                time.perf_counter() - t0, tier="shm"
+            )
+            emit_event(
+                "checkpoint_restore", step=config.step, tier="shm",
+                rank=self._rank,
+            )
             return config.step, state
-        return self.load_from_storage()
+        step, state = self.load_from_storage()
+        if step is not None:
+            _RESTORE_SECONDS.observe(
+                time.perf_counter() - t0, tier="storage"
+            )
+            emit_event(
+                "checkpoint_restore", step=step, tier="storage",
+                rank=self._rank,
+            )
+        return step, state
 
     def get_state_dict_from_memory(self):
         try:

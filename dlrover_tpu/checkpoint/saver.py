@@ -33,6 +33,22 @@ from dlrover_tpu.common.storage import (
     CheckpointStorage,
     get_checkpoint_storage,
 )
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_REG = get_registry()
+_PERSIST_SECONDS = _REG.histogram(
+    "dlrover_checkpoint_persist_seconds",
+    "Agent-side shm->storage persist time per step",
+)
+_PERSIST_ERRORS_TOTAL = _REG.counter(
+    "dlrover_checkpoint_persist_errors_total",
+    "Persist rounds with failed shards or timed-out commits",
+)
+_COMMITTED_STEP = _REG.gauge(
+    "dlrover_checkpoint_committed_step",
+    "Latest step whose tracker file was committed",
+)
 
 FACTORY_QUEUE = "ckpt_factory"
 EVENT_QUEUE = "ckpt_event_queue"
@@ -243,13 +259,23 @@ class AsyncCheckpointSaver:
         ok = all(f.result() for f in futures)
         if not ok:
             logger.error("step %s: some shards failed to persist", step)
+            _PERSIST_ERRORS_TOTAL.inc(reason="shard_failed")
+            emit_event(
+                "checkpoint_persist", step=step, ok=False,
+                seconds=round(time.time() - start, 3),
+            )
             return
         if self.config.node_rank == 0:
             self.commit_checkpoint(step, step_dir)
         self._last_persisted_step = step
+        elapsed = time.time() - start
+        _PERSIST_SECONDS.observe(elapsed)
+        emit_event(
+            "checkpoint_persist", step=step, ok=True,
+            seconds=round(elapsed, 3),
+        )
         logger.info(
-            "persisted checkpoint step %s in %.2fs", step,
-            time.time() - start,
+            "persisted checkpoint step %s in %.2fs", step, elapsed,
         )
 
     def _save_shard(
@@ -362,8 +388,11 @@ class AsyncCheckpointSaver:
                 self.storage.write(str(step), tracker)
                 self.storage.commit(step, True)
                 self._clean_old_checkpoints(step)
+                _COMMITTED_STEP.set(step)
+                emit_event("checkpoint_commit", step=step)
                 return
             time.sleep(0.5)
+        _PERSIST_ERRORS_TOTAL.inc(reason="commit_timeout")
         logger.error(
             "commit of step %s timed out (%s/%s done files)",
             step, len(done), expected,

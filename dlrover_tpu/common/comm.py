@@ -10,12 +10,16 @@ the unpickler is restricted to the message schema so a stray client
 cannot execute arbitrary reduce callables.
 
 Frame format: 8-byte big-endian length + pickle of
-``(verb, node_id, node_type, req_id, message)``; response frame is a
-pickled response message (``get``) or a bool ack (``report``).  The
-``req_id`` makes retries safe: the server caches responses by id and
-replays them instead of re-executing a handler whose response frame was
-lost, so reconnect-and-resend is exactly-once for non-idempotent
-requests (KV ``add`` barriers, failure reports, queue gets).
+``(verb, node_id, node_type, req_id, message[, trace_ctx])``; response
+frame is a pickled response message (``get``) or a bool ack
+(``report``).  The ``req_id`` makes retries safe: the server caches
+responses by id and replays them instead of re-executing a handler
+whose response frame was lost, so reconnect-and-resend is exactly-once
+for non-idempotent requests (KV ``add`` barriers, failure reports,
+queue gets).  ``trace_ctx`` is the optional telemetry trace context
+(``{"trace_id", "span_id"}``): the server adopts it while dispatching
+so a handler-opened span is a child of the caller's span; 5-tuple
+frames from older peers still dispatch.
 """
 
 import io
@@ -32,6 +36,7 @@ from typing import Optional
 
 from dlrover_tpu.common.constants import GRPC
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import tracing as _tracing
 
 _LEN = struct.Struct(">Q")
 _MAX_FRAME = GRPC.MAX_MESSAGE_BYTES
@@ -190,19 +195,23 @@ class _Connection(socketserver.BaseRequestHandler):
                 logger.exception("malformed frame; dropping connection")
                 return
             try:
-                verb, node_id, node_type, req_id, message = frame
+                verb, node_id, node_type, req_id, message = frame[:5]
+                trace_ctx = frame[5] if len(frame) > 5 else None
                 hit, resp = server.response_cache.get(req_id)
                 if not hit:
-                    if verb == "get":
-                        resp = server.handler.get(node_id, node_type, message)
-                    elif verb == "report":
-                        resp = server.handler.report(
-                            node_id, node_type, message
-                        )
-                    else:
-                        resp = RemoteError(
-                            "ValueError", f"unknown verb {verb!r}"
-                        )
+                    with _tracing.attach_context(trace_ctx):
+                        if verb == "get":
+                            resp = server.handler.get(
+                                node_id, node_type, message
+                            )
+                        elif verb == "report":
+                            resp = server.handler.report(
+                                node_id, node_type, message
+                            )
+                        else:
+                            resp = RemoteError(
+                                "ValueError", f"unknown verb {verb!r}"
+                            )
                     server.response_cache.put(req_id, resp)
             except Exception as e:
                 logger.exception("handler error for frame %r", frame[:1])
@@ -311,10 +320,17 @@ class MessageClient:
                 with self._lock:
                     if self._sock is None:
                         self._sock = self._connect()
-                    _send_frame(
-                        self._sock,
-                        (verb, self._node_id, self._node_type, req_id, message),
+                    # append the trace field only when a span is
+                    # active: the common no-span frame stays a
+                    # 5-tuple an un-upgraded server can unpack
+                    trace_ctx = _tracing.inject_context()
+                    frame = (
+                        verb, self._node_id, self._node_type,
+                        req_id, message,
                     )
+                    if trace_ctx is not None:
+                        frame += (trace_ctx,)
+                    _send_frame(self._sock, frame)
                     resp = _recv_frame(self._sock)
                 if isinstance(resp, Exception):
                     raise resp

@@ -1,0 +1,122 @@
+"""Version shims over moved JAX APIs.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map``, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` in the same era; depending on the
+installed jax only one spelling of each exists.  Every in-repo caller
+goes through this module and uses the NEW spellings; the shim rewrites
+them for an older jax.
+
+Resolution is lazy (first call) so importing a module that merely
+*mentions* shard_map — e.g. the agent's node_check — does not pay the
+jax import in processes that never run device code.
+"""
+
+import inspect
+import os
+
+_shard_map = None
+_check_kwarg = "check_vma"
+
+
+def shard_map(*args, **kwargs):
+    global _shard_map, _check_kwarg
+    if _shard_map is None:
+        import jax
+
+        try:
+            _shard_map = jax.shard_map
+        except AttributeError:  # pre-graduation jax (< 0.6)
+            from jax.experimental.shard_map import (
+                shard_map as _experimental,
+            )
+
+            _shard_map = _experimental
+        try:
+            params = inspect.signature(_shard_map).parameters
+            if "check_vma" not in params and "check_rep" in params:
+                _check_kwarg = "check_rep"
+        except (TypeError, ValueError):  # builtin/odd signature
+            pass
+    if _check_kwarg != "check_vma" and "check_vma" in kwargs:
+        kwargs[_check_kwarg] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)
+
+
+def memory_placement(kind: str):
+    """A ``jax.device_put`` destination meaning "same sharding, memory
+    ``kind``" for in-jit transfers.
+
+    Newer jax spells it ``jax.memory.Space``; before that the same
+    transfer is requested with ``TransferToMemoryKind`` (kinds
+    ``pinned_host`` / ``device``).
+    """
+    try:
+        from jax.memory import Space
+
+        return Space.Host if kind == "pinned_host" else Space.Device
+    except ImportError:
+        from jax._src.sharding_impls import TransferToMemoryKind
+
+        return TransferToMemoryKind(kind)
+
+
+def supports_memory_kind(kind: str) -> bool:
+    """Whether the default backend can place arrays in ``kind``
+    memory (the cpu backend of older jax only has unpinned_host)."""
+    import jax.numpy as jnp
+
+    try:
+        jnp.ones((1,)).sharding.with_memory_kind(kind)
+        return True
+    except (ValueError, NotImplementedError):
+        return False
+
+
+def ensure_cpu_collectives():
+    """Multi-process collectives on the CPU backend need a transport.
+
+    Newer jax defaults ``jax_cpu_collectives_implementation`` to gloo;
+    on 0.4.x the default is ``none`` and a cross-process psum/ppermute
+    blocks forever.  Select gloo before ``jax.distributed.initialize``
+    when running on CPU; a no-op where the option is gone (gloo is the
+    default there) or the backend already initialized.
+    """
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        return
+    current = getattr(
+        jax.config, "jax_cpu_collectives_implementation", None
+    )
+    if current not in (None, "none"):
+        return  # something already picked a real transport
+    try:
+        jax.config.update(
+            "jax_cpu_collectives_implementation", "gloo"
+        )
+    except (AttributeError, ValueError, RuntimeError):
+        pass  # option gone (newer jax defaults to gloo)
+
+
+def cost_analysis(compiled) -> dict:
+    """``Compiled.cost_analysis()`` normalized to one flat dict.
+
+    Newer jax returns a dict; older jax returns a list with one dict
+    per program (a single entry for an unpartitioned module).  Merge
+    by summing so per-program flops/bytes aggregate the same way XLA
+    reports them for the whole module.
+    """
+    cost = compiled.cost_analysis()
+    if cost is None:
+        return {}
+    if isinstance(cost, dict):
+        return cost
+    merged: dict = {}
+    for entry in cost:
+        for key, value in entry.items():
+            try:
+                merged[key] = merged.get(key, 0.0) + float(value)
+            except (TypeError, ValueError):
+                merged.setdefault(key, value)
+    return merged

@@ -26,6 +26,18 @@ from typing import Deque, Dict, List, Optional, Tuple
 from dlrover_tpu.common.constants import ErrorMonitorConstants
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import DiagnosisData
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_REG = get_registry()
+_STEP_TIME_HIST = _REG.histogram(
+    "dlrover_node_step_time_seconds",
+    "Per-node trainer step times reported through diagnosis data",
+)
+_VERDICT_TOTAL = _REG.counter(
+    "dlrover_diagnosis_verdicts_total",
+    "Diagnosis conclusions that demanded an action",
+)
 
 
 @dataclass
@@ -263,6 +275,16 @@ class DiagnosisManager:
 
     def collect(self, data: DiagnosisData):
         self._data[data.node_id].append(data)
+        if data.data_type == "step_time":
+            # write-through: the per-node step-time distribution is
+            # queryable from the registry, one source of truth with
+            # the windowed data the straggler operator medians over
+            try:
+                _STEP_TIME_HIST.observe(
+                    float(data.content), node=str(data.node_id)
+                )
+            except (TypeError, ValueError):
+                pass
 
     def node_data(self, node_id: int) -> List[DiagnosisData]:
         return list(self._data.get(node_id, []))
@@ -332,6 +354,17 @@ class DiagnosisManager:
                 verdict.action = a
                 break
         verdict.reason = "; ".join(reasons)
+        if verdict.hung or verdict.action != (
+            ErrorMonitorConstants.ACTION_NONE
+        ):
+            _VERDICT_TOTAL.inc(action=verdict.action)
+            emit_event(
+                "diagnosis_verdict",
+                hung=verdict.hung,
+                action=verdict.action,
+                culprit_node=verdict.culprit_node,
+                reason=verdict.reason,
+            )
         return verdict
 
     def _find_stuck_node(self) -> int:

@@ -10,6 +10,7 @@ scheduler-backed distributed flavour adds node watching/scaling on top
 (see :mod:`dlrover_tpu.master.node_manager`).
 """
 
+import os
 import threading
 import time
 from typing import Optional
@@ -32,6 +33,11 @@ from dlrover_tpu.master.rdzv_manager import (
 from dlrover_tpu.master.servicer import MasterServicer
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
+from dlrover_tpu.telemetry.events import emit_event, set_event_source
+from dlrover_tpu.telemetry.exporter import (
+    METRICS_PORT_ENV,
+    PrometheusEndpoint,
+)
 
 
 class JobMaster:
@@ -45,6 +51,7 @@ class JobMaster:
     ):
         self.job_name = job_name
         self.node_num = node_num
+        set_event_source("master")
         self.speed_monitor = SpeedMonitor()
         self.diagnosis_manager = DiagnosisManager()
         self._last_straggler_warned = -1
@@ -89,6 +96,23 @@ class JobMaster:
         )
         self._server = MessageServer(port, self.servicer)
         self.port = self._server.port
+        # one scrape of the master covers the whole job's
+        # control-plane view; DLROVER_METRICS_PORT enables it
+        # ("0" = ephemeral port, read back from .metrics_port)
+        self.metrics_endpoint: Optional[PrometheusEndpoint] = None
+        self.metrics_port = 0
+        metrics_port = os.getenv(METRICS_PORT_ENV)
+        if metrics_port is not None:
+            try:
+                self.metrics_endpoint = PrometheusEndpoint(
+                    port=int(metrics_port)
+                )
+                self.aux_services.append(self.metrics_endpoint)
+            except ValueError:
+                logger.warning(
+                    "invalid %s=%r; metrics endpoint disabled",
+                    METRICS_PORT_ENV, metrics_port,
+                )
         self._stop = threading.Event()
         self._exit_code = 0
         self._run_thread: Optional[threading.Thread] = None
@@ -108,7 +132,13 @@ class JobMaster:
         self.job_manager.start_heartbeat_monitor()
         for svc in self.aux_services:
             svc.start()
+        if self.metrics_endpoint is not None:
+            self.metrics_port = self.metrics_endpoint.port
         self._server.start()
+        emit_event(
+            "master_start", job=self.job_name, port=self.port,
+            node_num=self.node_num, metrics_port=self.metrics_port,
+        )
         logger.info(
             "master %s serving on port %s for %d node(s)",
             self.job_name,

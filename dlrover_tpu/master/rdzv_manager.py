@@ -30,6 +30,24 @@ from dlrover_tpu.common.constants import (
     RendezvousConstant,
 )
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import tracing as trace
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_REG = get_registry()
+_JOIN_TOTAL = _REG.counter(
+    "dlrover_rdzv_join_total", "Rendezvous join requests by manager"
+)
+_ROUND_SECONDS = _REG.histogram(
+    "dlrover_rdzv_round_seconds",
+    "Wall time from first join to round completion",
+)
+_ROUND_GAUGE = _REG.gauge(
+    "dlrover_rdzv_round", "Latest completed rendezvous round"
+)
+_NODES_GAUGE = _REG.gauge(
+    "dlrover_rdzv_nodes", "Nodes accepted into the latest round"
+)
 
 
 @dataclass
@@ -123,17 +141,23 @@ class RendezvousManager:
         local_world_size: int,
         node_ip: str = "",
     ) -> int:
-        with self._lock:
-            self._waiting_nodes[node_rank] = NodeMeta(
-                node_id=node_id,
-                node_rank=node_rank,
-                local_world_size=local_world_size,
-                node_ip=node_ip,
-            )
-            self._alive_nodes.add(node_id)
-            if not self._start_waiting_time:
-                self._start_waiting_time = time.time()
-            return self._rdzv_round
+        # the span's parent is the agent-side ``rdzv.join`` span whose
+        # context rode the RPC frame (comm.py attach_context)
+        with trace.span(
+            "rdzv.join", rdzv=self._name, node_rank=node_rank
+        ):
+            _JOIN_TOTAL.inc(rdzv=self._name)
+            with self._lock:
+                self._waiting_nodes[node_rank] = NodeMeta(
+                    node_id=node_id,
+                    node_rank=node_rank,
+                    local_world_size=local_world_size,
+                    node_ip=node_ip,
+                )
+                self._alive_nodes.add(node_id)
+                if not self._start_waiting_time:
+                    self._start_waiting_time = time.time()
+                return self._rdzv_round
 
     def _check_rdzv_completed(self) -> bool:
         """Caller holds the lock.  Mirrors reference
@@ -160,6 +184,10 @@ class RendezvousManager:
         if accept < max(p.min_nodes, 1):
             return False
         ranks = sorted(self._waiting_nodes.keys())[:accept]
+        wait_s = (
+            time.time() - self._start_waiting_time
+            if self._start_waiting_time else 0.0
+        )
         self._rdzv_nodes = {r: self._waiting_nodes.pop(r) for r in ranks}
         self._latest_rdzv_nodes = ranks
         # topology order computed once per completed round; every
@@ -167,6 +195,16 @@ class RendezvousManager:
         self._rank_order = self._topology_sorter.sort(self._rdzv_nodes)
         self._rdzv_round += 1
         self._start_waiting_time = 0.0
+        _ROUND_SECONDS.observe(wait_s, rdzv=self._name)
+        _ROUND_GAUGE.set(self._rdzv_round, rdzv=self._name)
+        _NODES_GAUGE.set(len(ranks), rdzv=self._name)
+        emit_event(
+            "rendezvous_complete",
+            rdzv=self._name,
+            round=self._rdzv_round,
+            nodes=ranks,
+            wait_s=round(wait_s, 3),
+        )
         logger.info(
             "%s rendezvous round %d completed with nodes %s",
             self._name,

@@ -5,6 +5,13 @@ report the trainer's global step; the master derives steps/sec and
 samples/sec over a sliding window, tracks the globally completed step
 (used by hang detection and checkpoint naming), and exposes windows in
 which worker membership changed so throughput comparisons skip them.
+
+The derived signals are written through the telemetry registry
+(``dlrover_global_step``, ``dlrover_steps_per_second``,
+``dlrover_goodput_ratio``, ``dlrover_running_workers``) so the
+Prometheus endpoint, diagnosis and any in-process consumer read the
+same numbers this monitor computes — one source of truth instead of
+private state plus ad-hoc log lines.
 """
 
 import statistics
@@ -13,10 +20,35 @@ import time
 from collections import deque
 from typing import Deque, Dict, Optional, Set, Tuple
 
+from dlrover_tpu.telemetry.metrics import MetricsRegistry, get_registry
+
 
 class SpeedMonitor:
-    def __init__(self, window: int = 50):
+    def __init__(
+        self, window: int = 50,
+        registry: Optional[MetricsRegistry] = None,
+    ):
         self._lock = threading.Lock()
+        reg = registry or get_registry()
+        self._step_gauge = reg.gauge(
+            "dlrover_global_step", "Globally completed training step"
+        )
+        self._speed_gauge = reg.gauge(
+            "dlrover_steps_per_second",
+            "Training speed over the sample window",
+        )
+        self._goodput_gauge = reg.gauge(
+            "dlrover_goodput_ratio",
+            "Fraction of wall-clock spent making step progress",
+        )
+        self._workers_gauge = reg.gauge(
+            "dlrover_running_workers", "Workers currently registered"
+        )
+        # a fresh monitor is a fresh job: zero the registry view
+        self._step_gauge.set(0)
+        self._speed_gauge.set(0.0)
+        self._goodput_gauge.set(0.0)
+        self._workers_gauge.set(0)
         # (timestamp, global_step) samples
         self._samples: Deque[Tuple[float, int]] = deque(maxlen=window)
         self._global_step = 0
@@ -82,9 +114,22 @@ class SpeedMonitor:
                 self._global_step = step
                 self._last_step_time = ts
                 self._samples.append((ts, step))
+                # write-through: registry readers (endpoint, textfile,
+                # diagnosis) see exactly what this monitor computed
+                self._step_gauge.set(step)
+                self._speed_gauge.set(self._running_speed_locked())
+                wall = time.time() - self._start_time
+                if wall > 0:
+                    self._goodput_gauge.set(
+                        min(1.0, self._productive_seconds / wall)
+                    )
 
     @property
     def completed_global_step(self) -> int:
+        # the instance field stays authoritative (registry gauges are
+        # process-global, so a second monitor in the same process —
+        # another job, a test — would alias reads through them); the
+        # write-through keeps the export surface in lockstep
         with self._lock:
             return self._global_step
 
@@ -93,15 +138,18 @@ class SpeedMonitor:
         with self._lock:
             return self._last_step_time
 
+    def _running_speed_locked(self) -> float:
+        if len(self._samples) < 2:
+            return 0.0
+        (t0, s0), (t1, s1) = self._samples[0], self._samples[-1]
+        if t1 <= t0:
+            return 0.0
+        return (s1 - s0) / (t1 - t0)
+
     def running_speed(self) -> float:
         """Steps/sec over the sample window."""
         with self._lock:
-            if len(self._samples) < 2:
-                return 0.0
-            (t0, s0), (t1, s1) = self._samples[0], self._samples[-1]
-            if t1 <= t0:
-                return 0.0
-            return (s1 - s0) / (t1 - t0)
+            return self._running_speed_locked()
 
     def samples_per_second(self) -> float:
         return self.running_speed() * self._batch_size
@@ -124,7 +172,9 @@ class SpeedMonitor:
             wall = time.time() - self._start_time
             if wall <= 0:
                 return 0.0
-            return min(1.0, self._productive_seconds / wall)
+            ratio = min(1.0, self._productive_seconds / wall)
+            self._goodput_gauge.set(ratio)
+            return ratio
 
     # -- membership-change windows ----------------------------------------
 
@@ -132,11 +182,13 @@ class SpeedMonitor:
         with self._lock:
             self._running_workers.add(node_id)
             self._worker_adjustment_time = time.time()
+            self._workers_gauge.set(len(self._running_workers))
 
     def remove_running_worker(self, node_id: int):
         with self._lock:
             self._running_workers.discard(node_id)
             self._worker_adjustment_time = time.time()
+            self._workers_gauge.set(len(self._running_workers))
 
     @property
     def running_workers(self) -> Set[int]:

@@ -45,6 +45,13 @@ namespace {
 
 constexpr int64_t kEmptyKey = INT64_MIN;
 
+// Consecutive spill-write failures (disk full, dead mount) that trip
+// the cold tier off.  Without the breaker every gather/scatter on an
+// over-budget table retries the FULL O(used*dim) slab rebuild only
+// for each row's pwrite to fail again — a hot loop of wasted work on
+// a disk that is not coming back by itself.
+constexpr long kMaxConsecutiveSpillFailures = 8;
+
 // On-disk cold tier: fixed-size records [dim*f32 values][u64 freq]
 // addressed by slot, with an in-DRAM key->slot index and a free list.
 struct SpillTier {
@@ -56,6 +63,9 @@ struct SpillTier {
   size_t rec_bytes = 0;
   long spills = 0;       // rows written out (cumulative)
   long promotions = 0;   // rows read back on miss (cumulative)
+  long write_failures = 0;       // short/failed pwrites (cumulative)
+  long consecutive_failures = 0; // resets on any successful write
+  bool disabled = false;         // tripped after repeated failures
 
   ~SpillTier() {
     if (fd >= 0) ::close(fd);
@@ -184,8 +194,22 @@ struct Table {
                              static_cast<off_t>(slot) * spill->rec_bytes);
     if (wrote != static_cast<ssize_t>(spill->rec_bytes)) {
       spill->free_slots.push_back(slot);  // disk full / IO error
+      ++spill->write_failures;
+      if (++spill->consecutive_failures >=
+          kMaxConsecutiveSpillFailures) {
+        if (!spill->disabled) {
+          std::fprintf(stderr,
+                       "kv_store: %ld consecutive spill-write "
+                       "failures on %s; disabling the cold tier "
+                       "(re-call kv_spill_enable to re-arm)\n",
+                       spill->consecutive_failures,
+                       spill->path.c_str());
+        }
+        spill->disabled = true;
+      }
       return false;
     }
+    spill->consecutive_failures = 0;
     spill->index[key] = slot;
     ++spill->spills;
     return true;
@@ -231,7 +255,13 @@ struct Table {
   // hysteresis amortizes the O(used*dim) slab rebuild across
   // ~max/10 inserts.
   void maybe_spill_cold() {
-    if (!spill || max_dram_rows == 0 || used <= max_dram_rows) return;
+    // disabled = the breaker tripped: DRAM stays over budget (rows
+    // are never dropped) instead of rebuilding the slab per op just
+    // to watch every pwrite fail again
+    if (!spill || spill->disabled || max_dram_rows == 0 ||
+        used <= max_dram_rows) {
+      return;
+    }
     size_t target = max_dram_rows - max_dram_rows / 10;
     size_t n_spill = used - target;
     // frequency threshold: the n_spill coldest rows go out
@@ -316,6 +346,10 @@ int kv_spill_enable(void* handle, const char* path, long max_dram_rows) {
     if (t->spill->path != path) return -2;
     t->max_dram_rows =
         max_dram_rows > 0 ? static_cast<size_t>(max_dram_rows) : 0;
+    // explicit re-enable re-arms a tripped failure breaker (the
+    // caller is asserting the disk is healthy again)
+    t->spill->disabled = false;
+    t->spill->consecutive_failures = 0;
     t->maybe_spill_cold();
     return 0;
   }
@@ -332,7 +366,8 @@ int kv_spill_enable(void* handle, const char* path, long max_dram_rows) {
 }
 
 // out[0]=rows on disk, out[1]=cumulative spills, out[2]=cumulative
-// promotions, out[3]=DRAM rows.
+// promotions, out[3]=DRAM rows, out[4]=cumulative write failures,
+// out[5]=1 when the failure breaker disabled spilling.
 void kv_spill_stats(void* handle, long* out) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
@@ -340,6 +375,8 @@ void kv_spill_stats(void* handle, long* out) {
   out[1] = t->spill ? t->spill->spills : 0;
   out[2] = t->spill ? t->spill->promotions : 0;
   out[3] = static_cast<long>(t->used);
+  out[4] = t->spill ? t->spill->write_failures : 0;
+  out[5] = (t->spill && t->spill->disabled) ? 1 : 0;
 }
 
 int kv_dim(void* handle) { return static_cast<Table*>(handle)->dim; }

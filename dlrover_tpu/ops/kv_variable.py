@@ -20,6 +20,20 @@ from typing import Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.native import build_library
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_REG = get_registry()
+_SPILL_FAILURES_GAUGE = _REG.gauge(
+    "dlrover_kv_spill_write_failures",
+    "Cumulative failed spill-tier writes (disk full / IO error)",
+)
+_SPILL_DISABLED_GAUGE = _REG.gauge(
+    "dlrover_kv_spill_disabled",
+    "1 when repeated spill-write failures tripped the cold tier off",
+)
+_SPILL_DISK_ROWS_GAUGE = _REG.gauge(
+    "dlrover_kv_spill_disk_rows", "Rows resident in the cold tier"
+)
 
 _lib = None
 
@@ -189,14 +203,27 @@ class KvVariable:
             raise OSError(f"cannot open spill file {path!r}")
 
     def spill_stats(self) -> dict:
-        out = (ctypes.c_long * 4)()
+        out = (ctypes.c_long * 6)()
         self._lib.kv_spill_stats(self._handle, out)
-        return {
+        stats = {
             "disk_rows": int(out[0]),
             "spills": int(out[1]),
             "promotions": int(out[2]),
             "dram_rows": int(out[3]),
+            "write_failures": int(out[4]),
+            "disabled": bool(out[5]),
         }
+        # write-through to the telemetry registry so the master
+        # endpoint / agent textfile surface the failure breaker
+        # without a separate polling path
+        _SPILL_FAILURES_GAUGE.set(
+            stats["write_failures"], table=self.name
+        )
+        _SPILL_DISABLED_GAUGE.set(
+            1.0 if stats["disabled"] else 0.0, table=self.name
+        )
+        _SPILL_DISK_ROWS_GAUGE.set(stats["disk_rows"], table=self.name)
+        return stats
 
     def frequency(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)

@@ -19,11 +19,11 @@ model fits but Adam states don't.
 import jax
 import optax
 
+from dlrover_tpu.common.jax_compat import memory_placement
+
 
 def _to(kind: str):
-    from jax.memory import Space
-
-    space = Space.Host if kind == "pinned_host" else Space.Device
+    space = memory_placement(kind)
 
     def move(x):
         # Scalars (step counts) stay put: offloading them saves

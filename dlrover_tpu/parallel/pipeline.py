@@ -31,6 +31,7 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+from dlrover_tpu.common.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -139,7 +140,7 @@ def pipeline_apply(
         )
 
     x_spec = P(batch_axis) if batch_axis is not None else P()
-    out = jax.shard_map(
+    out = shard_map(
         local,
         mesh=mesh,
         in_specs=(
@@ -390,7 +391,7 @@ def pipeline_train_step_1f1b(
     y = jax.lax.with_sharding_constraint(
         y, NamedSharding(mesh, x_spec)
     )
-    loss, grads, head_grads, input_grads = jax.shard_map(
+    loss, grads, head_grads, input_grads = shard_map(
         local,
         mesh=mesh,
         in_specs=(p_spec, hp_spec, x_spec, x_spec),

@@ -24,6 +24,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from dlrover_tpu.common.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -75,7 +76,7 @@ def ulysses_attention(
         return rev_a2a(out)
 
     spec = P(("data", "fsdp"), axis, None, None)
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False,
     )(q, k, v)
@@ -204,7 +205,7 @@ def ring_attention(
         return (acc / safe_l[..., None]).astype(q.dtype)
 
     spec = P(("data", "fsdp"), axis, None, None)
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False,
     )(q, k, v)

@@ -1,0 +1,68 @@
+"""Unified telemetry: metrics registry, span tracer, training events.
+
+The paper's control plane (fault diagnosis, auto-scaling, Brain
+resource optimization) runs on runtime signals; the reference DLRover
+ships a dedicated training-event/metrics exporter layer
+(``dlrover/python/training_event``, ``master/monitor``) for exactly
+this reason.  This package is that layer for the TPU stack, with zero
+hard dependencies:
+
+- :mod:`dlrover_tpu.telemetry.metrics` — process-local registry of
+  counters/gauges/histograms with labels (thread-safe), rendered in
+  Prometheus text exposition format.
+- :mod:`dlrover_tpu.telemetry.tracing` — lightweight span tracer with
+  parent/child context propagation, carried across the master↔agent
+  RPC by :mod:`dlrover_tpu.common.comm`.
+- :mod:`dlrover_tpu.telemetry.events` — append-only JSONL training
+  event log (schema-versioned, size-rotated), shared by master, agent
+  and trainer processes through ``DLROVER_EVENT_LOG``.
+- :mod:`dlrover_tpu.telemetry.exporter` — a Prometheus scrape
+  endpoint served from the master plus a textfile dump fallback for
+  agents.
+"""
+
+from dlrover_tpu.telemetry.events import (
+    EVENT_SCHEMA_VERSION,
+    TrainingEventExporter,
+    emit_event,
+    set_event_source,
+)
+from dlrover_tpu.telemetry.exporter import (
+    PrometheusEndpoint,
+    TextfileDumper,
+)
+from dlrover_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from dlrover_tpu.telemetry.tracing import (
+    SpanContext,
+    Tracer,
+    attach_context,
+    get_tracer,
+    inject_context,
+    span,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "SpanContext",
+    "Tracer",
+    "attach_context",
+    "get_tracer",
+    "inject_context",
+    "span",
+    "EVENT_SCHEMA_VERSION",
+    "TrainingEventExporter",
+    "emit_event",
+    "set_event_source",
+    "PrometheusEndpoint",
+    "TextfileDumper",
+]

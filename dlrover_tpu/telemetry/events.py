@@ -1,0 +1,158 @@
+"""Append-only JSONL training-event log.
+
+Role of the reference's training-event exporter
+(``dlrover/python/training_event``: an async JSONL exporter the
+master/agent/trainer all write through).  Here a single schema-
+versioned line format shared by every process of a job:
+
+    {"schema": 1, "ts": <epoch s>, "pid": <pid>, "source": "master",
+     "type": "rendezvous_complete", ...event fields...}
+
+The destination is ``DLROVER_EVENT_LOG`` (inherited by the master
+subprocess and the spawned trainers, so one file collects the whole
+job) or an explicitly configured path.  Emission is a no-op when no
+path is configured — telemetry must never be a hard dependency of
+training.  Writes are single ``write()`` calls of one line in append
+mode, so concurrent processes interleave whole lines; rotation renames
+the file to ``<path>.1`` when it exceeds ``max_bytes``.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+EVENT_SCHEMA_VERSION = 1
+EVENT_LOG_ENV = "DLROVER_EVENT_LOG"
+EVENT_LOG_MAX_BYTES_ENV = "DLROVER_EVENT_LOG_MAX_BYTES"
+EVENT_SOURCE_ENV = "DLROVER_EVENT_SOURCE"
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+
+class TrainingEventExporter:
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        max_bytes: Optional[int] = None,
+        backups: int = 1,
+        source: str = "",
+    ):
+        self._explicit_path = path
+        self._max_bytes = max_bytes
+        self._backups = max(1, backups)
+        self._source = source
+        self._lock = threading.Lock()
+
+    # -- configuration -----------------------------------------------------
+
+    def set_source(self, source: str):
+        self._source = source
+
+    @property
+    def path(self) -> Optional[str]:
+        """Resolved at call time so a process that configures the env
+        var after import (tests, spawned workers) still exports."""
+        return self._explicit_path or os.environ.get(EVENT_LOG_ENV)
+
+    def _resolved_max_bytes(self) -> int:
+        if self._max_bytes is not None:
+            return self._max_bytes
+        try:
+            return int(
+                os.environ.get(
+                    EVENT_LOG_MAX_BYTES_ENV, DEFAULT_MAX_BYTES
+                )
+            )
+        except ValueError:
+            return DEFAULT_MAX_BYTES
+
+    # -- emit --------------------------------------------------------------
+
+    def emit(self, event_type: str, **fields) -> bool:
+        """Append one event; returns False when unconfigured or the
+        write failed (never raises into the training path)."""
+        path = self.path
+        if not path:
+            return False
+        record = {
+            "schema": EVENT_SCHEMA_VERSION,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            # explicit set_source wins; the env fallback lets the
+            # agent tag arbitrary user entrypoints it spawns without
+            # those scripts calling into telemetry themselves
+            "source": (
+                self._source
+                or os.environ.get(EVENT_SOURCE_ENV, "")
+                or "unknown"
+            ),
+            "type": event_type,
+        }
+        record.update(fields)
+        try:
+            line = json.dumps(record, default=str)
+        except (TypeError, ValueError):
+            return False
+        with self._lock:
+            try:
+                self._maybe_rotate(path, len(line) + 1)
+                with open(path, "a") as f:
+                    f.write(line + "\n")
+                return True
+            except OSError:
+                return False
+
+    def _maybe_rotate(self, path: str, incoming: int):
+        limit = self._resolved_max_bytes()
+        if limit <= 0:
+            return
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size + incoming <= limit:
+            return
+        for i in range(self._backups, 0, -1):
+            src = path if i == 1 else f"{path}.{i - 1}"
+            try:
+                os.replace(src, f"{path}.{i}")
+            except OSError:
+                pass
+
+
+def read_events(path: str) -> Iterator[Dict]:
+    """Parse a JSONL event log, skipping torn/partial lines (a
+    concurrent writer may be mid-line at read time)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
+
+
+_default_exporter: Optional[TrainingEventExporter] = None
+_default_lock = threading.Lock()
+
+
+def get_exporter() -> TrainingEventExporter:
+    global _default_exporter
+    with _default_lock:
+        if _default_exporter is None:
+            _default_exporter = TrainingEventExporter()
+        return _default_exporter
+
+
+def emit_event(event_type: str, **fields) -> bool:
+    """Process-global convenience used by instrumented subsystems."""
+    return get_exporter().emit(event_type, **fields)
+
+
+def set_event_source(source: str):
+    """Tag this process's events (``master`` / ``agent`` /
+    ``trainer``) — set once at process entry."""
+    get_exporter().set_source(source)

@@ -1,0 +1,140 @@
+"""Metric export surfaces: master scrape endpoint + agent textfile.
+
+- :class:`PrometheusEndpoint`: a threaded HTTP server answering
+  ``GET /metrics`` with the registry's text exposition — the master
+  serves this next to its message port so one scrape covers the whole
+  job's control-plane view (reference capability: the master's
+  monitor/metric reporting, surfaced in standard exposition format).
+- :class:`TextfileDumper`: agents (no stable scrape address under
+  churn) periodically write the same exposition to a file for the
+  node-exporter textfile collector to pick up.
+"""
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import metrics as _metrics
+
+METRICS_PORT_ENV = "DLROVER_METRICS_PORT"
+METRICS_TEXTFILE_ENV = "DLROVER_METRICS_TEXTFILE"
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 - http.server API
+        registry: _metrics.MetricsRegistry = (
+            self.server.registry  # type: ignore[attr-defined]
+        )
+        if self.path.split("?")[0] not in ("/metrics", "/"):
+            self.send_error(404)
+            return
+        body = registry.render_prometheus().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-scrape stderr
+        pass
+
+
+class PrometheusEndpoint:
+    """``GET /metrics`` over a daemon thread (start()/stop() matches
+    the master's aux-service interface)."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        registry: Optional[_metrics.MetricsRegistry] = None,
+    ):
+        self._requested_port = port
+        self._host = host
+        self._registry = registry or _metrics.get_registry()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port = 0
+
+    def start(self):
+        if self._server is not None:
+            return
+        try:
+            self._server = ThreadingHTTPServer(
+                (self._host, self._requested_port), _MetricsHandler
+            )
+        except OSError as e:
+            # telemetry must never be a hard dependency: a stolen or
+            # privileged port degrades to "no endpoint", not a dead
+            # master
+            logger.warning(
+                "metrics endpoint cannot bind port %s (%s); "
+                "metrics endpoint disabled",
+                self._requested_port, e,
+            )
+            self._server = None
+            return
+        self._server.daemon_threads = True
+        self._server.registry = self._registry  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="metrics-endpoint",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("metrics endpoint serving on port %s", self.port)
+
+    def stop(self):
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        self._server = None
+        self._thread = None
+
+
+class TextfileDumper:
+    """Periodic registry dump for node-exporter textfile collection
+    (agent fallback when there is no scrapeable address)."""
+
+    def __init__(
+        self,
+        path: str,
+        interval: float = 15.0,
+        registry: Optional[_metrics.MetricsRegistry] = None,
+    ):
+        self._path = path
+        self._interval = interval
+        self._registry = registry or _metrics.get_registry()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def dump_once(self) -> bool:
+        try:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(self._registry.render_prometheus())
+            os.replace(tmp, self._path)
+            return True
+        except OSError as e:
+            logger.debug("metrics textfile dump failed: %s", e)
+            return False
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="metrics-textfile"
+            )
+            self._thread.start()
+
+    def _run(self):
+        while not self._stopped.wait(self._interval):
+            self.dump_once()
+        self.dump_once()  # final flush so short runs leave a dump
+
+    def stop(self):
+        self._stopped.set()

@@ -24,13 +24,24 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from dlrover_tpu.common import env_utils
+from dlrover_tpu.common import env_utils, jax_compat
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.parallel.mesh import dp_world_size
 from dlrover_tpu.parallel.sharding import (
     PartitionRules,
     batch_spec,
     sharding_tree,
+)
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_REG = get_registry()
+_REPORTED_STEP = _REG.gauge(
+    "dlrover_trainer_reported_step",
+    "Latest step the trainer wrote to the agent-tailed metrics file",
+)
+_GRAD_ACCUM_GAUGE = _REG.gauge(
+    "dlrover_trainer_grad_accum",
+    "Gradient-accumulation factor keeping the global batch fixed",
 )
 
 
@@ -159,6 +170,7 @@ class ElasticTrainer:
             os.path.join("/tmp", f"dlrover_metrics_{os.getuid()}.json"),
         )
         self._epoch = 0
+        _GRAD_ACCUM_GAUGE.set(self.grad_accum)
         logger.info(
             "elastic trainer: global_batch=%s micro=%s dp=%s accum=%s",
             global_batch_size, micro_batch_size, self.dp_size,
@@ -175,6 +187,7 @@ class ElasticTrainer:
         agent monitor tails (reference: trainer.py report to file +
         monitor/training.py)."""
         self.global_step += 1
+        _REPORTED_STEP.set(self.global_step)
         record = {
             "global_step": self.global_step,
             "timestamp": time.time(),
@@ -218,6 +231,7 @@ def init_jax_distributed():
     if not coordinator or num_processes <= 1:
         return False
     process_id = int(os.getenv("DLROVER_PROCESS_ID", "0"))
+    jax_compat.ensure_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

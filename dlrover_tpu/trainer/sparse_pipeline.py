@@ -142,13 +142,30 @@ class SparseTrainPipeline:
         if self.pipeline == "auto":
             # probe strictly, then commit: a tiny host fraction means
             # double buffering only adds overhead (VERDICT r4 weak #3
-            # — the device fetch can dwarf the table work)
+            # — the device fetch can dwarf the table work).  The
+            # FIRST batch jit-compiles device_step, so its dispatch
+            # time is seconds of XLA work that steady state never
+            # pays — counting it would shrink the host fraction and
+            # wrongly commit to strict; run it outside the probe
+            # accounting (it still trains and still accumulates into
+            # self.stats for the overlap report)
             it = iter(batches)
+            warmup = list(itertools.islice(it, 1))
+            state = self._run_strict(state, warmup, on_aux)
+            base = {
+                k: self.stats[k]
+                for k in ("gather_s", "update_s", "dispatch_s",
+                          "fetch_s")
+            }
             probe = list(itertools.islice(it, 3))
             state = self._run_strict(state, probe, on_aux)
-            host = self.stats["gather_s"] + self.stats["update_s"]
-            busy = host + self.stats["dispatch_s"] + \
-                self.stats["fetch_s"]
+            host = (
+                self.stats["gather_s"] - base["gather_s"]
+                + self.stats["update_s"] - base["update_s"]
+            )
+            busy = host + \
+                (self.stats["dispatch_s"] - base["dispatch_s"]) + \
+                (self.stats["fetch_s"] - base["fetch_s"])
             frac = host / max(busy, 1e-9)
             self.chosen_mode = (
                 "pipelined" if frac >= 0.2 else "strict"

@@ -25,3 +25,20 @@ os.environ["DLROVER_SHARED_DIR"] = os.path.join(
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the stdlib-only telemetry unit tests before the jit/e2e
+    heavyweights.  On a slow box a wall-clock-bounded CI window can
+    truncate the (alphabetical) tail of the suite; these tests cost
+    milliseconds, must never be the ones dropped (every other
+    subsystem now records through the registry they verify), and are
+    side-effect-free first (they only touch fresh registry/exporter
+    instances or clear the global tracer themselves)."""
+    early = [
+        it for it in items
+        if it.nodeid.split("::", 1)[0].endswith("test_telemetry.py")
+    ]
+    if early:
+        rest = [it for it in items if it not in early]
+        items[:] = early + rest

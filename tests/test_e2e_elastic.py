@@ -18,6 +18,11 @@ from bench import ELASTIC_TRAIN_SCRIPT as TRAIN_SCRIPT
 
 def test_tpurun_crash_restart_restore(tmp_path, monkeypatch):
     monkeypatch.setenv("DLROVER_SHARED_DIR", str(tmp_path / "sock"))
+    # one JSONL event log collects the whole job: the master
+    # subprocess, this (agent) process and the trainer workers all
+    # inherit the env var and append to it
+    event_log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("DLROVER_EVENT_LOG", str(event_log))
     script = tmp_path / "train.py"
     script.write_text(TRAIN_SCRIPT)
     ckpt_dir = tmp_path / "ckpt"
@@ -39,6 +44,67 @@ def test_tpurun_crash_restart_restore(tmp_path, monkeypatch):
     assert crash_flag.exists()  # the crash really happened
     step, shards = read_last_checkpoint(str(ckpt_dir))
     assert step == 5 and 0 in shards
+    _assert_telemetry(event_log)
+
+
+def _assert_telemetry(event_log):
+    """One elastic run must leave the full observability trail
+    (ISSUE 1 acceptance): linked rendezvous spans across the
+    agent->master RPC, checkpoint events, queryable histograms, and
+    a Prometheus dump with dlrover_ metrics."""
+    from dlrover_tpu.telemetry.events import read_events
+    from dlrover_tpu.telemetry.metrics import get_registry
+
+    events = list(read_events(str(event_log)))
+    by_type = {}
+    for e in events:
+        by_type.setdefault(e["type"], []).append(e)
+
+    # rendezvous: the master emitted round completion, and its
+    # handler-side rdzv.join span is the child of the agent-side
+    # span whose context rode the RPC frame
+    assert by_type.get("rendezvous_complete"), events
+    spans = by_type.get("span", [])
+    agent_joins = [
+        s for s in spans
+        if s["name"] == "rdzv.join" and s["source"] == "agent"
+    ]
+    master_joins = [
+        s for s in spans
+        if s["name"] == "rdzv.join" and s["source"] == "master"
+    ]
+    assert agent_joins and master_joins
+    agent_ids = {s["span_id"] for s in agent_joins}
+    agent_traces = {s["trace_id"] for s in agent_joins}
+    linked = [
+        m for m in master_joins
+        if m["parent_id"] in agent_ids
+        and m["trace_id"] in agent_traces
+    ]
+    assert linked, (agent_joins, master_joins)
+
+    # checkpoint path: trainer-side shm saves, agent-side persist
+    assert by_type.get("checkpoint_shm_save")
+    assert by_type.get("checkpoint_persist")
+    # the crash triggered a worker restart event
+    assert by_type.get("worker_restart")
+    for e in events:
+        assert e["schema"] == 1
+        assert e["source"] in ("master", "agent", "trainer")
+
+    # histograms queryable from THIS process's registry (the agent
+    # and the async saver run here): checkpoint persist latency and
+    # the agent's rendezvous latency both recorded
+    reg = get_registry()
+    persist = reg.get("dlrover_checkpoint_persist_seconds")
+    assert persist is not None and persist.snapshot()["count"] >= 1
+    rdzv = reg.get("dlrover_agent_rdzv_seconds")
+    assert rdzv.snapshot(rdzv="elastic-training")["count"] >= 1
+
+    # Prometheus text dump carries the dlrover_ metric families
+    dump = reg.render_prometheus()
+    assert "dlrover_checkpoint_persist_seconds_bucket" in dump
+    assert dump.count("dlrover_") > 10
 
 
 def test_goodput_accounting_through_crash(tmp_path, monkeypatch):

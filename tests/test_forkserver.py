@@ -155,3 +155,57 @@ def test_exit_tracking_survives_template_rebuild(srv, tmp_path):
         "old-generation worker's death was never observed"
     )
     os.kill(h_new.pid, signal.SIGKILL)
+
+
+def test_exit_bookkeeping_pruned_after_consumption(srv, tmp_path):
+    """A long-lived elastic agent respawns workers every round; the
+    per-pid bookkeeping must be pruned once a handle consumed the
+    exit code, or the server grows without bound across rounds."""
+    script = tmp_path / "quick.py"
+    script.write_text("pass\n")
+    handles = [srv.spawn([str(script)], {}, timeout=30.0)
+               for _ in range(3)]
+    for h in handles:
+        assert h.wait(timeout=20.0) == 0
+    # the handle keeps answering from its local cache...
+    for h in handles:
+        assert h.poll() == 0
+    # ...while the server-side maps are empty again
+    assert srv._exits == {}
+    assert srv._pid_generation == {}
+    assert srv._pid_start == {}
+    assert srv._spawned == []
+
+
+def test_pid_recycle_guard_uses_start_time(srv, tmp_path):
+    """The stale-generation liveness probe must not trust a bare
+    pid-exists check: after pid wraparound an unrelated process can
+    hold the number.  A recorded spawn start time that no longer
+    matches /proc/<pid>/stat means OUR worker exited."""
+    script = tmp_path / "sleeper3.py"
+    script.write_text("import time\ntime.sleep(600)\n")
+    h = srv.spawn([str(script)], {}, timeout=30.0)
+    # sanity: the real start time was recorded and matches
+    assert srv._pid_start[h.pid] == srv._proc_start_time(h.pid)
+    # simulate recycling: mark the generation stale (forcing the
+    # direct probe) and make the recorded start time disagree with
+    # the live process at this pid
+    with srv._lock:
+        srv._pid_generation[h.pid] = srv._generation - 1
+        srv._pid_start[h.pid] = 1  # no real process started at tick 1
+    assert srv.exit_code(h.pid) == -1  # treated as exited
+    os.kill(h.pid, signal.SIGKILL)
+
+
+def test_proc_start_time_none_for_dead_pid(srv, tmp_path):
+    script = tmp_path / "quick2.py"
+    script.write_text("pass\n")
+    h = srv.spawn([str(script)], {}, timeout=30.0)
+    assert h.wait(timeout=20.0) == 0
+    assert isinstance(srv._proc_start_time(os.getpid()), int)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if srv._proc_start_time(h.pid) is None:
+            break
+        time.sleep(0.05)  # template may not have reaped the zombie yet
+    assert srv._proc_start_time(h.pid) is None

@@ -313,3 +313,39 @@ def test_spill_tier_eviction_reaches_disk(tmp_path):
     evicted = table.evict_below(2)  # drops freq-1 rows on BOTH tiers
     assert evicted == 350
     assert len(table) == 50
+
+
+def test_spill_write_failure_breaker(tmp_path):
+    """A dead/full spill disk must not be retried forever: failures
+    are counted, the breaker disables the cold tier after repeated
+    consecutive failures (no more per-op slab rebuilds), no row is
+    ever dropped, and an explicit re-enable re-arms the tier."""
+    import os
+
+    if not os.path.exists("/dev/full"):
+        pytest.skip("/dev/full not available")
+    table = KvVariable(dim=4, initial_capacity=64, seed=3)
+    keys = np.arange(300, dtype=np.int64)
+    vals = np.arange(1200, dtype=np.float32).reshape(300, 4)
+    table.insert(keys, vals)
+    # a symlink keeps ~SpillTier's unlink() off the real /dev/full
+    link = tmp_path / "full.spill"
+    os.symlink("/dev/full", link)
+    table.enable_spill(str(link), max_dram_rows=100)  # every pwrite ENOSPC
+    st = table.spill_stats()
+    assert st["write_failures"] >= 8, st
+    assert st["disabled"] is True, st
+    assert st["disk_rows"] == 0, st
+    # nothing was lost: all rows still resident and intact
+    assert len(table) == 300
+    got = table.gather(keys, insert_missing=False, count_freq=False)
+    np.testing.assert_allclose(got, vals)
+    # the tripped breaker stops the retry loop: further ops do not
+    # grow the failure counter
+    failures_at_trip = st["write_failures"]
+    table.gather(keys[:50])
+    assert table.spill_stats()["write_failures"] == failures_at_trip
+    # explicit re-enable (the caller asserts the disk recovered)
+    # re-arms the breaker
+    table.enable_spill(str(link), max_dram_rows=400)  # over budget: no spill
+    assert table.spill_stats()["disabled"] is False

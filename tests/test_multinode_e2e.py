@@ -127,16 +127,31 @@ print(f"PROBE rank {rank} elapsed {elapsed:.2f}", flush=True)
 
 def _make_throttle_cgroup(quota_pct: int = 20):
     """A cgroup-v1 cpu group limiting its tasks to quota_pct of one
-    CPU; None when the controller is not writable (then the test
-    skips — no fake fallback)."""
+    CPU; None when the controller is not usable (then the test
+    skips — no fake fallback).  Usable means a process can actually
+    be ATTACHED: sandboxed kernels (gVisor) expose a writable
+    cgroupfs but reject the cgroup.procs write with EINVAL, which
+    would crash the throttled worker mid-run instead of skipping."""
     cg = "/sys/fs/cgroup/cpu/dlrover_xprobe"
+    probe = None
     try:
         os.makedirs(cg, exist_ok=True)
         with open(os.path.join(cg, "cpu.cfs_quota_us"), "w") as f:
             f.write(str(1000 * quota_pct))
+        probe = subprocess.Popen(["sleep", "30"])
+        with open(os.path.join(cg, "cgroup.procs"), "a") as f:
+            f.write(str(probe.pid))
         return cg
     except OSError:
+        try:
+            os.rmdir(cg)
+        except OSError:
+            pass
         return None
+    finally:
+        if probe is not None:
+            probe.kill()
+            probe.wait()
 
 
 def test_cross_host_probe_isolates_real_straggler(tmp_path):

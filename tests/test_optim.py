@@ -7,6 +7,7 @@ import numpy as np
 import optax
 import pytest
 
+from dlrover_tpu.common import jax_compat
 from dlrover_tpu.ops.quantization import (
     dequantize_blockwise,
     quantize_blockwise,
@@ -285,6 +286,14 @@ def test_q_adafactor_relative_step_runs():
     assert np.isfinite(np.asarray(final["w"])).all()
 
 
+needs_pinned_host = pytest.mark.skipif(
+    not jax_compat.supports_memory_kind("pinned_host"),
+    reason="backend has no pinned_host memory kind "
+           "(older-jax cpu backend)",
+)
+
+
+@needs_pinned_host
 def test_offload_state_lives_on_host():
     from dlrover_tpu.optim import adamw_offload
 
@@ -303,6 +312,7 @@ def test_offload_state_lives_on_host():
     )
 
 
+@needs_pinned_host
 def test_offload_sharded_state_host_roundtrip_eager():
     """Sharded (mesh) opt state round-trips host<->device with its
     sharding preserved.  Eager-mode: the CPU backend's SPMD
@@ -385,7 +395,10 @@ def test_offload_through_auto_accelerate():
         for x in jax.tree.leaves(result.state.opt_state)
         if getattr(x, "ndim", 0) > 0
     }
-    expected = {"device"} if on_cpu else {"pinned_host"}
+    # degraded-to-no-op states stay in the backend's DEFAULT memory,
+    # whatever this jax calls it ("device" / "unpinned_host")
+    default_kind = jnp.ones((1,)).sharding.memory_kind
+    expected = {default_kind} if on_cpu else {"pinned_host"}
     assert kinds == expected, kinds
     if on_cpu:
         assert any(

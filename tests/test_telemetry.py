@@ -1,0 +1,359 @@
+"""Telemetry subsystem: registry semantics (labels, buckets,
+concurrency), span nesting + cross-RPC context propagation, JSONL
+event schema/rotation, and the Prometheus exposition surfaces."""
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.common.comm import (
+    MessageClient,
+    MessageServer,
+    RequestHandler,
+)
+from dlrover_tpu.telemetry.events import (
+    EVENT_LOG_ENV,
+    EVENT_SCHEMA_VERSION,
+    TrainingEventExporter,
+    read_events,
+)
+from dlrover_tpu.telemetry.exporter import (
+    PrometheusEndpoint,
+    TextfileDumper,
+)
+from dlrover_tpu.telemetry.metrics import (
+    Counter,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from dlrover_tpu.telemetry.tracing import (
+    Tracer,
+    attach_context,
+    current_context,
+    inject_context,
+)
+
+# -- metrics registry -----------------------------------------------------
+
+
+def test_counter_labels_and_values():
+    reg = MetricsRegistry()
+    c = reg.counter("dlrover_test_total", "help text")
+    c.inc()
+    c.inc(2, node="a")
+    c.inc(3, node="a")
+    c.inc(1, node="b")
+    assert c.value() == 1
+    assert c.value(node="a") == 5
+    assert c.value(node="b") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_registry_get_or_create_and_type_clash():
+    reg = MetricsRegistry()
+    a = reg.counter("dlrover_x_total")
+    assert reg.counter("dlrover_x_total") is a
+    with pytest.raises(TypeError):
+        reg.gauge("dlrover_x_total")
+    with pytest.raises(ValueError):
+        reg.counter("bad name with spaces")
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("dlrover_g")
+    g.set(5, shard="0")
+    g.inc(2, shard="0")
+    g.dec(3, shard="0")
+    assert g.value(shard="0") == 4
+    assert g.value(shard="missing") == 0.0
+
+
+def test_histogram_buckets_and_snapshot():
+    reg = MetricsRegistry()
+    h = reg.histogram("dlrover_h_seconds", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(56.05)
+    # cumulative per upper bound, +Inf catches the overflow
+    assert snap["buckets"][0.1] == 1
+    assert snap["buckets"][1.0] == 3
+    assert snap["buckets"][10.0] == 4
+    assert snap["buckets"][float("inf")] == 5
+    # labeled series are independent
+    h.observe(0.2, phase="x")
+    assert h.snapshot(phase="x")["count"] == 1
+    assert h.snapshot()["count"] == 5
+
+
+def test_registry_concurrent_updates_are_exact():
+    reg = MetricsRegistry()
+    c = reg.counter("dlrover_conc_total")
+    h = reg.histogram("dlrover_conc_seconds", buckets=[1.0])
+
+    def work():
+        for _ in range(1000):
+            c.inc(thread="t")
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(thread="t") == 8000
+    assert h.snapshot()["count"] == 8000
+    assert h.snapshot()["buckets"][1.0] == 8000
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("dlrover_req_total", "requests").inc(3, verb='g"x\n')
+    reg.gauge("dlrover_up").set(1)
+    reg.histogram(
+        "dlrover_lat_seconds", "latency", buckets=[0.5]
+    ).observe(0.25)
+    text = reg.render_prometheus()
+    assert "# HELP dlrover_req_total requests" in text
+    assert "# TYPE dlrover_req_total counter" in text
+    # label values escape quotes and newlines
+    assert 'dlrover_req_total{verb="g\\"x\\n"} 3' in text
+    assert "# TYPE dlrover_up gauge" in text
+    assert "dlrover_up 1" in text
+    assert 'dlrover_lat_seconds_bucket{le="0.5"} 1' in text
+    assert 'dlrover_lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "dlrover_lat_seconds_sum 0.25" in text
+    assert "dlrover_lat_seconds_count 1" in text
+    # every non-comment line is <name>{labels}? <number>
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? -?[0-9.e+\-]+$|"
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \+Inf$"
+    )
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert sample_re.match(line), line
+
+
+# -- span tracer ----------------------------------------------------------
+
+
+def test_span_nesting_parent_child():
+    tracer = Tracer(registry=MetricsRegistry())
+    with tracer.span("outer", job="j") as outer:
+        assert current_context().span_id == outer.span_id
+        with tracer.span("inner") as inner:
+            pass
+    assert current_context() is None
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+    assert outer.parent_id is None
+    assert outer.attributes == {"job": "j"}
+    names = [s.name for s in tracer.finished_spans()]
+    assert names == ["inner", "outer"]  # inner finishes first
+    assert all(s.duration >= 0 for s in tracer.finished_spans())
+
+
+def test_span_error_status_and_duration_histogram():
+    reg = MetricsRegistry()
+    tracer = Tracer(registry=reg)
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom"):
+            raise RuntimeError("x")
+    (s,) = tracer.finished_spans("boom")
+    assert s.status == "error"
+    assert "RuntimeError" in s.attributes["error"]
+    hist = reg.get("dlrover_span_seconds")
+    assert hist.snapshot(name="boom")["count"] == 1
+
+
+def test_inject_and_attach_context():
+    tracer = Tracer(registry=MetricsRegistry())
+    assert inject_context() is None
+    with tracer.span("client-op") as s:
+        wire = inject_context()
+    assert wire == {"trace_id": s.trace_id, "span_id": s.span_id}
+    # server side adopts the wire context for the dispatch scope
+    with attach_context(wire):
+        with tracer.span("server-op") as child:
+            pass
+    assert current_context() is None
+    assert child.trace_id == s.trace_id
+    assert child.parent_id == s.span_id
+    # malformed contexts are a no-op, never an error
+    for bad in (None, "x", {}, {"trace_id": 1, "span_id": 2}):
+        with attach_context(bad):
+            assert current_context() is None
+
+
+class _TracingHandler(RequestHandler):
+    """Opens a span inside the dispatch, like the rendezvous
+    manager's join path does."""
+
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def get(self, node_id, node_type, message):
+        with self.tracer.span("server.handle") as s:
+            return {
+                "trace_id": s.trace_id,
+                "parent_id": s.parent_id,
+            }
+
+    def report(self, node_id, node_type, message):
+        return True
+
+
+def test_trace_context_propagates_across_rpc():
+    tracer = Tracer(registry=MetricsRegistry())
+    server = MessageServer(0, _TracingHandler(tracer), host="127.0.0.1")
+    server.start()
+    client = MessageClient(f"127.0.0.1:{server.port}", node_id=0)
+    try:
+        # the global tracer's contextvar is what comm.py injects, so
+        # drive the client inside a GLOBAL span
+        from dlrover_tpu.telemetry import tracing
+
+        with tracing.span("agent.op") as agent_span:
+            seen = client.get({"op": "x"})
+        assert seen["trace_id"] == agent_span.trace_id
+        assert seen["parent_id"] == agent_span.span_id
+        # no active span -> no context, and the server span is a root
+        seen = client.get({"op": "y"})
+        assert seen["parent_id"] is None
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- JSONL training events ------------------------------------------------
+
+
+def test_event_log_schema_and_source(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    exp = TrainingEventExporter(path=path, source="master")
+    assert exp.emit("rendezvous_complete", round=1, nodes=[0, 1])
+    exp.set_source("agent")
+    assert exp.emit("worker_restart", restart_count=2)
+    events = list(read_events(path))
+    assert [e["type"] for e in events] == [
+        "rendezvous_complete", "worker_restart",
+    ]
+    for e in events:
+        assert e["schema"] == EVENT_SCHEMA_VERSION
+        assert isinstance(e["ts"], float)
+        assert isinstance(e["pid"], int)
+    assert events[0]["source"] == "master"
+    assert events[0]["nodes"] == [0, 1]
+    assert events[1]["source"] == "agent"
+
+
+def test_event_log_unconfigured_is_noop(monkeypatch):
+    monkeypatch.delenv(EVENT_LOG_ENV, raising=False)
+    exp = TrainingEventExporter()
+    assert exp.emit("anything") is False
+
+
+def test_event_log_env_resolution(tmp_path, monkeypatch):
+    path = str(tmp_path / "env_events.jsonl")
+    exp = TrainingEventExporter()  # created BEFORE the env is set
+    monkeypatch.setenv(EVENT_LOG_ENV, path)
+    assert exp.emit("late_config") is True
+    (e,) = read_events(path)
+    assert e["type"] == "late_config"
+
+
+def test_event_log_rotation(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    exp = TrainingEventExporter(path=path, max_bytes=400, backups=1)
+    for i in range(50):
+        assert exp.emit("tick", i=i)
+    rotated = tmp_path / "rot.jsonl.1"
+    assert rotated.exists()
+    # both files parse; no event line is torn
+    live = list(read_events(path))
+    old = list(read_events(str(rotated)))
+    assert live and old
+    assert all(e["type"] == "tick" for e in live + old)
+
+
+def test_read_events_skips_torn_lines(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text(
+        json.dumps({"schema": 1, "type": "ok"}) + "\n"
+        + '{"schema": 1, "type": "tor'  # partial write
+    )
+    events = list(read_events(str(path)))
+    assert [e["type"] for e in events] == ["ok"]
+
+
+# -- export surfaces ------------------------------------------------------
+
+
+def test_prometheus_endpoint_serves_registry():
+    reg = MetricsRegistry()
+    reg.counter("dlrover_scrape_total", "scrapes").inc(7)
+    ep = PrometheusEndpoint(port=0, host="127.0.0.1", registry=reg)
+    ep.start()
+    try:
+        url = f"http://127.0.0.1:{ep.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "dlrover_scrape_total 7" in body
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{ep.port}/nope"
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=5)
+    finally:
+        ep.stop()
+
+
+def test_textfile_dumper(tmp_path):
+    reg = MetricsRegistry()
+    reg.gauge("dlrover_workers").set(3)
+    out = tmp_path / "metrics.prom"
+    dumper = TextfileDumper(str(out), registry=reg)
+    assert dumper.dump_once()
+    assert "dlrover_workers 3" in out.read_text()
+
+
+def test_master_starts_metrics_endpoint(monkeypatch):
+    from dlrover_tpu.master.master import JobMaster
+
+    monkeypatch.setenv("DLROVER_METRICS_PORT", "0")
+    master = JobMaster(port=0, node_num=1, job_name="metrics-e2e")
+    master.prepare()
+    try:
+        assert master.metrics_port > 0
+        url = f"http://127.0.0.1:{master.metrics_port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = resp.read().decode()
+        # the global registry carries the master's own gauges
+        assert "dlrover_global_step" in body
+        assert "dlrover_" in body
+    finally:
+        master.stop()
+
+
+def test_speed_monitor_writes_through_registry():
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+    sm = SpeedMonitor()
+    reg = get_registry()
+    sm.add_running_worker(0)
+    sm.collect_global_step(7)
+    assert sm.completed_global_step == 7
+    assert reg.get("dlrover_global_step").value() == 7
+    assert reg.get("dlrover_running_workers").value() == 1
+    sm.remove_running_worker(0)
+    assert reg.get("dlrover_running_workers").value() == 0
