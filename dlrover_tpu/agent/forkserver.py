@@ -402,15 +402,15 @@ class WorkerForkServer:
     @staticmethod
     def _proc_start_time(pid: int) -> Optional[int]:
         """Kernel start time of ``pid`` (/proc/<pid>/stat field 22,
-        clock ticks since boot); None when the pid is gone.  comm
-        (field 2) may itself contain spaces or ')', so fields are
-        parsed after the LAST ')'."""
+        clock ticks since boot); None when the pid is gone."""
+        from dlrover_tpu.common.env_utils import proc_stat_fields
+
+        fields = proc_stat_fields(pid)
+        if fields is None:
+            return None
         try:
-            with open(f"/proc/{pid}/stat", "rb") as f:
-                data = f.read()
-            rest = data.rsplit(b")", 1)[1].split()
-            return int(rest[19])
-        except (OSError, IndexError, ValueError):
+            return int(fields[19])
+        except (IndexError, ValueError):
             return None
 
     def exit_code(self, pid: int) -> Optional[int]:

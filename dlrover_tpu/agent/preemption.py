@@ -28,6 +28,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
+from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common.log import default_logger as logger
 
 GCE_PREEMPTED_URL = (
@@ -104,7 +105,10 @@ class PreemptionMonitor:
 
     def _run(self):
         while not self._stopped.is_set():
-            if self._probe():
+            # chaos hook: a preempt rule simulates the metadata server
+            # flipping to TRUE without any GCE dependency — the full
+            # notice -> report -> breakpoint-save path runs for real
+            if _chaos.fire("preemption.probe") or self._probe():
                 logger.warning(
                     "PREEMPTION NOTICE from %s — persisting "
                     "checkpoint state before shutdown", self._url,

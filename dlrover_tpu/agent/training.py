@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
+from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.monitor import (
     HeartbeatReporter,
@@ -548,6 +549,15 @@ class ElasticTrainingAgent:
         self._initialize_workers()
         while True:
             time.sleep(self._spec.monitor_interval)
+            # chaos hook: a kill_worker rule signals one of the
+            # supervised processes here, and THIS VERY POLL observes
+            # the death — the recovery path under test is the real
+            # monitor/restart machinery, not a shortcut
+            _chaos.fire(
+                "agent.monitor",
+                procs=self._procs,
+                restart_count=self._restart_count,
+            )
             state, codes = self._monitor_workers()
             if state == WorkerState.SUCCEEDED:
                 logger.info("all workers finished successfully")

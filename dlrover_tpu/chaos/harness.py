@@ -1,0 +1,549 @@
+"""Chaos harness: drive a mini-cluster through a scenario and verify
+recovery invariants from the telemetry event log alone.
+
+:func:`run_scenario` launches the same supervision tree production
+uses — ``tpurun`` spawns a local master subprocess, runs the elastic
+agent in-process, and the agent spawns/monitors the toy train loop —
+with ``DLROVER_CHAOS`` exported so every process of the job arms the
+scenario, and ``DLROVER_EVENT_LOG`` collecting one JSONL stream from
+all of them.  Afterwards the :class:`Invariant` checkers read ONLY
+that event log (plus a /proc scan for the orphan check): if an
+invariant cannot be decided from telemetry, the telemetry is the bug.
+
+Invariants shipped here:
+
+- :class:`WorkerRestarted` — the fault produced a supervised restart.
+- :class:`RendezvousReconverged` — an elastic-training rendezvous
+  completed AFTER the fault, within a bound.
+- :class:`BoundedStepLoss` — steps lost across the fault ≤ one
+  checkpoint interval (from ``train_step`` + ``chaos_inject`` events).
+- :class:`TrainingCompleted` — the step budget finished and the final
+  checkpoint committed.
+- :class:`DeterministicTimeline` — the ``chaos_inject`` sequence
+  matches a reference timeline (cross-run determinism).
+- :class:`NoOrphanProcesses` — nothing spawned for the job outlives
+  it (forkserver children included).
+"""
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu import chaos as _chaos
+from dlrover_tpu.chaos.scenarios import (
+    CHAOS_TRAIN_SCRIPT,
+    CKPT_EVERY_ENV,
+    TOTAL_STEPS_ENV,
+)
+from dlrover_tpu.chaos.schedule import Scenario, load_scenario
+from dlrover_tpu.common.env_utils import proc_stat_fields
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry.events import EVENT_LOG_ENV, read_events
+
+CHAOS_EVENT = "chaos_inject"
+
+
+@dataclass
+class InvariantResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+    def __bool__(self):
+        return self.ok
+
+
+class Invariant:
+    """Base checker: decide pass/fail from the job's event list."""
+
+    name = "invariant"
+
+    def check(self, events: List[dict],
+              run: "ChaosRunReport") -> InvariantResult:
+        raise NotImplementedError
+
+
+def _injections(events: List[dict]) -> List[dict]:
+    return [e for e in events if e.get("type") == CHAOS_EVENT]
+
+
+def _first_fault_ts(events: List[dict]) -> Optional[float]:
+    inj = _injections(events)
+    return inj[0]["ts"] if inj else None
+
+
+class WorkerRestarted(Invariant):
+    name = "worker_restarted"
+
+    def check(self, events, run):
+        fault_ts = _first_fault_ts(events)
+        if fault_ts is None:
+            return InvariantResult(
+                self.name, False, "no chaos_inject event recorded"
+            )
+        restarts = [
+            e for e in events
+            if e.get("type") == "worker_restart"
+            and e["ts"] >= fault_ts
+        ]
+        if not restarts:
+            return InvariantResult(
+                self.name, False, "no worker_restart after the fault"
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{len(restarts)} restart(s) after fault",
+        )
+
+
+class RendezvousReconverged(Invariant):
+    """An elastic-training rendezvous completed after the fault, and
+    the gap stayed under ``within_s``."""
+
+    name = "rendezvous_reconverged"
+
+    def __init__(self, within_s: float = 120.0):
+        self.within_s = within_s
+
+    def check(self, events, run):
+        fault_ts = _first_fault_ts(events)
+        if fault_ts is None:
+            return InvariantResult(
+                self.name, False, "no chaos_inject event recorded"
+            )
+        rounds = [
+            e for e in events
+            if e.get("type") == "rendezvous_complete"
+            and e.get("rdzv") == "elastic-training"
+            and e["ts"] > fault_ts
+        ]
+        if not rounds:
+            return InvariantResult(
+                self.name, False,
+                "no elastic-training rendezvous completed after the "
+                "fault",
+            )
+        gap = rounds[0]["ts"] - fault_ts
+        if gap > self.within_s:
+            return InvariantResult(
+                self.name, False,
+                f"reconverged after {gap:.1f}s > bound {self.within_s}s",
+            )
+        return InvariantResult(
+            self.name, True, f"reconverged in {gap:.1f}s"
+        )
+
+
+class BoundedStepLoss(Invariant):
+    """Steps lost across the fault ≤ one checkpoint interval, computed
+    from telemetry only: the highest ``train_step`` of the first
+    incarnation vs the first ``train_step`` of a respawned one."""
+
+    name = "bounded_step_loss"
+
+    def __init__(self, ckpt_interval: int):
+        self.ckpt_interval = ckpt_interval
+
+    def check(self, events, run):
+        first = [
+            e["step"] for e in events
+            if e.get("type") == "train_step"
+            and e.get("restart_count", 0) == 0
+        ]
+        resumed = [
+            e["step"] for e in events
+            if e.get("type") == "train_step"
+            and e.get("restart_count", 0) > 0
+        ]
+        if not first:
+            return InvariantResult(
+                self.name, False, "no train_step events at all"
+            )
+        if not resumed:
+            return InvariantResult(
+                self.name, False,
+                "no post-restart train_step events (recovery never "
+                "stepped)",
+            )
+        last_before = max(first)
+        resume_at = min(resumed)
+        lost = last_before - (resume_at - 1)
+        if lost > self.ckpt_interval:
+            return InvariantResult(
+                self.name, False,
+                f"lost {lost} step(s) (last pre-fault {last_before}, "
+                f"resumed at {resume_at}) > interval "
+                f"{self.ckpt_interval}",
+            )
+        if lost < 0:
+            return InvariantResult(
+                self.name, False,
+                f"resumed AHEAD of progress (last pre-fault "
+                f"{last_before}, resumed at {resume_at})",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"lost {lost} step(s) ≤ interval {self.ckpt_interval} "
+            f"(resumed at {resume_at} after {last_before})",
+        )
+
+
+class TrainingCompleted(Invariant):
+    """The job stepped through its full budget and committed the final
+    checkpoint."""
+
+    name = "training_completed"
+
+    def __init__(self, total_steps: int):
+        self.total_steps = total_steps
+
+    def check(self, events, run):
+        steps = [
+            e["step"] for e in events if e.get("type") == "train_step"
+        ]
+        commits = [
+            e["step"] for e in events
+            if e.get("type") == "checkpoint_commit"
+        ]
+        if not steps or max(steps) < self.total_steps:
+            return InvariantResult(
+                self.name, False,
+                f"highest step {max(steps) if steps else None} < "
+                f"budget {self.total_steps}",
+            )
+        if self.total_steps not in commits:
+            return InvariantResult(
+                self.name, False,
+                f"final step {self.total_steps} never committed "
+                f"(commits: {sorted(set(commits))})",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"stepped to {max(steps)}, committed {self.total_steps}",
+        )
+
+
+class DiagnosisEmitted(Invariant):
+    """The master's diagnosis chain reached the expected action."""
+
+    name = "diagnosis_emitted"
+
+    def __init__(self, action: str):
+        self.action = action
+
+    def check(self, events, run):
+        verdicts = [
+            e for e in events if e.get("type") == "diagnosis_verdict"
+        ]
+        hits = [v for v in verdicts if v.get("action") == self.action]
+        if not hits:
+            return InvariantResult(
+                self.name, False,
+                f"no diagnosis_verdict with action {self.action!r} "
+                f"(saw {[v.get('action') for v in verdicts]})",
+            )
+        return InvariantResult(self.name, True, hits[0].get("reason", ""))
+
+
+class DeterministicTimeline(Invariant):
+    """The run's fault timeline equals a reference timeline (usually a
+    prior run of the same scenario+seed)."""
+
+    name = "deterministic_timeline"
+
+    def __init__(self, reference: Sequence[Tuple]):
+        self.reference = [tuple(r) for r in reference]
+
+    def check(self, events, run):
+        timeline = timeline_from_events(events)
+        if timeline != self.reference:
+            return InvariantResult(
+                self.name, False,
+                f"timeline {timeline} != reference {self.reference}",
+            )
+        return InvariantResult(
+            self.name, True, f"{len(timeline)} injection(s) identical"
+        )
+
+
+class NoOrphanProcesses(Invariant):
+    """No process whose cmdline or environment references the job's
+    workdir survives the run — catches leaked trainers, forkserver
+    children whose template died, and the local master (matched via
+    its inherited env)."""
+
+    name = "no_orphan_processes"
+
+    def __init__(self, marker: str, grace_s: float = 5.0):
+        self.marker = marker
+        self.grace_s = grace_s
+
+    def check(self, events, run):
+        deadline = time.time() + self.grace_s
+        leftovers = scan_processes(self.marker)
+        while leftovers and time.time() < deadline:
+            time.sleep(0.2)  # freshly-killed procs may linger a beat
+            leftovers = scan_processes(self.marker)
+        if leftovers:
+            return InvariantResult(
+                self.name, False, f"orphans: {leftovers}"
+            )
+        return InvariantResult(self.name, True, "no survivors")
+
+
+def _ancestors(pid: int) -> List[int]:
+    """pid plus its ppid chain up to init (a shell wrapper invoking
+    the harness carries the workdir in ITS cmdline and must never be
+    reported as an orphan)."""
+    chain = []
+    while pid > 1 and len(chain) < 64:
+        chain.append(pid)
+        fields = proc_stat_fields(pid)
+        if fields is None:
+            break
+        try:
+            pid = int(fields[1])  # ppid
+        except (IndexError, ValueError):
+            break
+    chain.append(pid)
+    return chain
+
+
+def scan_processes(marker: str) -> List[int]:
+    """Live (non-zombie) pids whose cmdline OR environment contains
+    ``marker``, excluding this process and its ancestors.  The environ
+    check is what catches a leaked local master: its argv carries no
+    workdir, but it inherits ``DLROVER_SHARED_DIR=<workdir>/sock``."""
+    skip = set(_ancestors(os.getpid()))
+    out: List[int] = []
+    marker_b = marker.encode()
+    # stdlib runtime infrastructure legitimately outlives a run and
+    # inherits the run's env (the harness's own multiprocessing
+    # resource tracker, spawned lazily mid-run) — never an orphan
+    infra = (b"resource_tracker", b"semaphore_tracker",
+             b"multiprocessing.forkserver")
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if pid in skip:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read()
+            if any(tag in cmdline for tag in infra):
+                continue
+            matched = marker_b in cmdline
+            if not matched:
+                try:
+                    with open(f"/proc/{pid}/environ", "rb") as f:
+                        matched = marker_b in f.read()
+                except OSError:  # other-user process: environ hidden
+                    pass
+            if not matched:
+                continue
+            fields = proc_stat_fields(pid)
+            if fields is not None and fields[0] != b"Z":
+                out.append(pid)
+        except OSError:
+            continue
+    return out
+
+
+def timeline_from_events(events: List[dict]) -> List[Tuple]:
+    """Cross-run-comparable fault timeline from the event log:
+    ``(seq, point, rule, action, step)`` per injection, ordered by
+    emitting source then per-process seq (with step as tiebreak).
+    Caveat: two processes with the SAME source both injecting (e.g. a
+    future multi-agent partition) collide on (source, seq) — such
+    scenarios need a per-process discriminator in the key before
+    their timelines compare stably across runs."""
+    inj = _injections(events)
+    inj.sort(
+        key=lambda e: (
+            e.get("source", ""), e.get("seq", 0), e.get("step") or 0,
+        )
+    )
+    return [
+        (
+            e.get("seq"), e.get("point"), e.get("rule"),
+            e.get("action"), e.get("step"),
+        )
+        for e in inj
+    ]
+
+
+@dataclass
+class ChaosRunReport:
+    scenario: str
+    seed: int
+    rc: int
+    workdir: str
+    event_log: str
+    events: List[dict] = field(default_factory=list)
+    timeline: List[Tuple] = field(default_factory=list)
+    invariants: List[InvariantResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.rc == 0 and all(r.ok for r in self.invariants)
+
+    def summary(self) -> str:
+        lines = [
+            f"scenario {self.scenario!r} seed={self.seed} rc={self.rc}",
+            f"events: {len(self.events)}  injections: "
+            f"{len(self.timeline)}",
+        ]
+        for t in self.timeline:
+            lines.append(f"  inject {t}")
+        for r in self.invariants:
+            mark = "PASS" if r.ok else "FAIL"
+            lines.append(f"  [{mark}] {r.name}: {r.detail}")
+        lines.append("RESULT: " + ("PASS" if self.ok else "FAIL"))
+        return "\n".join(lines)
+
+
+class _patched_env:
+    """Set env vars for the run, restore the previous values after —
+    the harness runs inside long-lived test processes."""
+
+    def __init__(self, values: Dict[str, str]):
+        self._values = values
+        self._saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        for k, v in self._values.items():
+            self._saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False
+
+
+def default_invariants(
+    total_steps: int, ckpt_every: int, workdir: str
+) -> List[Invariant]:
+    """The full recovery set — appropriate for scenarios whose fault
+    is expected to crash a worker."""
+    return [
+        WorkerRestarted(),
+        RendezvousReconverged(),
+        BoundedStepLoss(ckpt_interval=ckpt_every),
+        TrainingCompleted(total_steps=total_steps),
+        NoOrphanProcesses(marker=workdir),
+    ]
+
+
+# scenarios whose fault kills a worker and therefore must show the
+# full restart/reconverge/step-loss trail; every other scenario's
+# DESIRED outcome is "the job rides it out with no restart at all",
+# so only completion + no-orphans apply
+RECOVERY_SCENARIOS = frozenset({
+    "kill-worker-midstep", "sigterm-worker-midstep",
+})
+
+
+def invariants_for_scenario(
+    name: str, total_steps: int, ckpt_every: int, workdir: str
+) -> List[Invariant]:
+    if name in RECOVERY_SCENARIOS:
+        return default_invariants(total_steps, ckpt_every, workdir)
+    return [
+        TrainingCompleted(total_steps=total_steps),
+        NoOrphanProcesses(marker=workdir),
+    ]
+
+
+def run_scenario(
+    scenario,
+    workdir: str,
+    total_steps: int = 10,
+    ckpt_every: int = 2,
+    max_restarts: int = 2,
+    monitor_interval: float = 0.3,
+    warm_restart: bool = False,
+    invariants: Optional[List[Invariant]] = None,
+) -> ChaosRunReport:
+    """Run ``scenario`` against a fresh single-node mini-cluster under
+    ``workdir`` and evaluate the invariants.  With ``invariants=None``
+    the set is chosen by scenario name (recovery scenarios get the
+    full restart trail, ride-it-out scenarios completion+no-orphans);
+    pass ``invariants=[]`` to skip checking entirely."""
+    scenario = load_scenario(scenario)
+    os.makedirs(workdir, exist_ok=True)
+    spec_path = os.path.join(workdir, "chaos_scenario.json")
+    with open(spec_path, "w") as f:
+        json.dump(scenario.to_dict(), f, indent=2)
+    script = os.path.join(workdir, "chaos_train.py")
+    with open(script, "w") as f:
+        f.write(CHAOS_TRAIN_SCRIPT)
+    event_log = os.path.join(workdir, "events.jsonl")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    env = {
+        _chaos.CHAOS_ENV: spec_path,
+        EVENT_LOG_ENV: event_log,
+        TOTAL_STEPS_ENV: str(total_steps),
+        CKPT_EVERY_ENV: str(ckpt_every),
+        "DLROVER_SHARED_DIR": os.path.join(workdir, "sock"),
+        "DLROVER_METRICS_FILE": os.path.join(workdir, "metrics.json"),
+        # isolation: an ambient master address (a previous in-process
+        # run, an outer job) must not hijack this mini-cluster — empty
+        # means "spawn a fresh local master"
+        "DLROVER_MASTER_ADDR": "",
+    }
+    argv = [
+        "--nproc_per_node=1",
+        f"--max_restarts={max_restarts}",
+        f"--monitor_interval={monitor_interval}",
+    ]
+    if warm_restart:
+        argv.append("--warm-restart")
+    argv += [script, ckpt_dir]
+
+    from dlrover_tpu import run as tpurun
+
+    with _patched_env(env):
+        # arm in-process too: the agent (and its saver/monitors) runs
+        # in THIS process, and its hook points must see the scenario
+        _chaos.install(scenario)
+        try:
+            rc = tpurun.main(argv)
+        finally:
+            _chaos.uninstall()
+
+    events = list(read_events(event_log)) if os.path.exists(
+        event_log
+    ) else []
+    report = ChaosRunReport(
+        scenario=scenario.name,
+        seed=scenario.seed,
+        rc=rc,
+        workdir=workdir,
+        event_log=event_log,
+        events=events,
+        timeline=timeline_from_events(events),
+    )
+    checks = (
+        invariants if invariants is not None
+        else invariants_for_scenario(
+            scenario.name, total_steps, ckpt_every, workdir
+        )
+    )
+    for inv in checks:
+        try:
+            report.invariants.append(inv.check(events, report))
+        except Exception as e:  # noqa: BLE001 - a checker bug is a FAIL
+            logger.exception("invariant %s crashed", inv.name)
+            report.invariants.append(
+                InvariantResult(inv.name, False, f"checker crashed: {e}")
+            )
+    return report

@@ -1,0 +1,248 @@
+"""Built-in chaos scenarios + the toy elastic train loop they drive.
+
+Each scenario is a factory ``(seed) -> Scenario`` registered in
+:data:`SCENARIOS`; the CLI (``python -m dlrover_tpu.chaos``) and the
+e2e tests run them through :mod:`dlrover_tpu.chaos.harness`.  They are
+deliberately small compositions of the schedule vocabulary — the point
+of the subsystem is that new failure modes are a dict away, not a new
+test file away.
+"""
+
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.chaos.schedule import Scenario
+
+# knobs the harness exports to the training subprocess
+TOTAL_STEPS_ENV = "DLROVER_CHAOS_TOTAL_STEPS"
+CKPT_EVERY_ENV = "DLROVER_CHAOS_CKPT_EVERY"
+
+# Toy GPT elastic train loop (mirrors bench.py's ELASTIC_TRAIN_SCRIPT
+# shape, minus the self-inflicted crash — faults come exclusively from
+# the chaos schedule).  Flash-checkpoints to shm every CKPT_EVERY
+# steps; a killed incarnation restores from the snapshot the agent
+# kept alive and finishes the fixed step budget; the final step is
+# persisted to disk and committed.  argv: ckpt_dir
+CHAOS_TRAIN_SCRIPT = r'''
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticTrainer, TrainState, make_train_step,
+)
+
+ckpt_dir = sys.argv[1]
+TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "10"))
+CKPT_EVERY = int(os.environ.get("DLROVER_CHAOS_CKPT_EVERY", "2"))
+
+cfg = GPTConfig.tiny()
+model = GPT(cfg)
+optimizer = optax.adam(1e-3)
+
+def loss_fn(p, batch):
+    logits = model.apply({"params": p}, batch["x"])
+    return cross_entropy_loss(logits, batch["y"])
+
+step_fn = make_train_step(loss_fn, optimizer)
+ckpt = Checkpointer(ckpt_dir)
+start_step, restored = ckpt.load_checkpoint()
+if start_step is None:
+    params = model.init_params(jax.random.PRNGKey(0))
+    start_step = 0
+else:
+    params = jax.tree.map(jnp.asarray, restored["params"])
+state = TrainState.create(params, optimizer)
+
+trainer = ElasticTrainer(global_batch_size=8, micro_batch_size=8,
+                         dp_size=1)
+trainer.global_step = start_step
+rng = np.random.default_rng(0)
+data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
+batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
+
+for i in range(start_step, TOTAL_STEPS):
+    state, metrics = step_fn(state, batch)
+    # report_step emits the train_step event and fires the
+    # trainer.step chaos hook — a kill rule ends the process HERE
+    trainer.report_step(metrics)
+    if trainer.global_step % CKPT_EVERY == 0:
+        ckpt.save_checkpoint(
+            trainer.global_step,
+            {"params": state.params, "trainer": trainer.state_dict()},
+            storage_type=StorageType.MEMORY,
+        )
+
+ckpt.save_checkpoint(
+    TOTAL_STEPS,
+    {"params": state.params, "trainer": trainer.state_dict()},
+    storage_type=StorageType.DISK,
+)
+ckpt.wait()
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+deadline = time.time() + 60
+while time.time() < deadline and not os.path.exists(tracker):
+    time.sleep(0.2)
+assert os.path.exists(tracker), "checkpoint commit did not land"
+ckpt.close()
+'''
+
+
+def kill_worker_midstep(seed: int = 42) -> Scenario:
+    """THE acceptance scenario: SIGKILL the worker at a seed-chosen
+    step mid-run.  The agent's monitor loop observes the death,
+    persists the shm snapshot, re-rendezvouses and respawns; the
+    recovered incarnation must lose at most one checkpoint interval."""
+    return Scenario.from_dict({
+        "name": "kill-worker-midstep",
+        "seed": seed,
+        "rules": [{
+            "name": "kill-midstep",
+            "point": "trainer.step",
+            "action": "kill",
+            "step_window": [4, 7],
+            "only_first_incarnation": True,
+        }],
+    })
+
+
+def sigterm_worker_midstep(seed: int = 42) -> Scenario:
+    """Graceful-eviction flavour of the kill scenario (SIGTERM)."""
+    return Scenario.from_dict({
+        "name": "sigterm-worker-midstep",
+        "seed": seed,
+        "rules": [{
+            "name": "term-midstep",
+            "point": "trainer.step",
+            "action": "kill",
+            "step_window": [4, 7],
+            "only_first_incarnation": True,
+            "args": {"signal": "TERM"},
+        }],
+    })
+
+
+def rpc_partition(seed: int = 7) -> Scenario:
+    """Drop every master RPC for a 2 s window early in the run: the
+    client's jittered-backoff reconnect path must ride it out with no
+    job impact beyond latency."""
+    return Scenario.from_dict({
+        "name": "rpc-partition",
+        "seed": seed,
+        "rules": [{
+            "name": "partition",
+            "point": "rpc.client.roundtrip",
+            "action": "drop",
+            "after_time": 1.0,
+            "duration": 2.0,
+        }],
+    })
+
+
+def storage_brownout(seed: int = 11) -> Scenario:
+    """Every storage write fails for the first few persist attempts,
+    then the backend 'recovers': persistence must degrade to a
+    reported failure (telemetry event, error counter) and the next
+    interval's save must still commit."""
+    return Scenario.from_dict({
+        "name": "storage-brownout",
+        "seed": seed,
+        "rules": [{
+            "name": "flaky-writes",
+            "point": "storage.write",
+            "action": "io_error",
+            "max_count": 3,
+        }],
+    })
+
+
+def storage_stall(seed: int = 13) -> Scenario:
+    """One slow (hung-NFS-style) storage write mid-run."""
+    return Scenario.from_dict({
+        "name": "storage-stall",
+        "seed": seed,
+        "rules": [{
+            "name": "stalled-write",
+            "point": "storage.write",
+            "action": "stall",
+            "after_calls": 2,
+            "max_count": 1,
+            "args": {"seconds": 1.0},
+        }],
+    })
+
+
+def straggler(seed: int = 5) -> Scenario:
+    """Seeded-probabilistic slow steps: the per-node step-time
+    distribution degrades and the diagnosis chain's straggler rule has
+    something real to catch in multi-node runs."""
+    return Scenario.from_dict({
+        "name": "straggler",
+        "seed": seed,
+        "rules": [{
+            "name": "slow-steps",
+            "point": "trainer.step",
+            "action": "slow",
+            "prob": 0.5,
+            "max_count": 5,
+            "args": {"seconds": 0.3},
+        }],
+    })
+
+
+def preemption_notice(seed: int = 3) -> Scenario:
+    """Simulated ~30s-warning spot preemption: the monitor's probe
+    reads TRUE, the agent reports to the master and breakpoint-saves
+    the shm snapshot while the 'VM' is still alive."""
+    return Scenario.from_dict({
+        "name": "preemption-notice",
+        "seed": seed,
+        "rules": [{
+            "name": "notice",
+            "point": "preemption.probe",
+            "action": "preempt",
+            "after_time": 2.0,
+        }],
+    })
+
+
+def shm_corruption(seed: int = 17) -> Scenario:
+    """Tear one shm snapshot right after it is written (writing=True
+    republish): the persist and restore paths must refuse the torn
+    snapshot instead of committing garbage."""
+    return Scenario.from_dict({
+        "name": "shm-corruption",
+        "seed": seed,
+        "rules": [{
+            "name": "torn-snapshot",
+            "point": "ckpt.shm_save",
+            "action": "corrupt_shm",
+            "at_step": 4,
+            "args": {"mode": "torn"},
+        }],
+    })
+
+
+SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
+    "kill_worker_midstep": kill_worker_midstep,
+    "sigterm_worker_midstep": sigterm_worker_midstep,
+    "rpc_partition": rpc_partition,
+    "storage_brownout": storage_brownout,
+    "storage_stall": storage_stall,
+    "straggler": straggler,
+    "preemption_notice": preemption_notice,
+    "shm_corruption": shm_corruption,
+}
+
+
+def build(name: str, seed: Optional[int] = None) -> Scenario:
+    if name not in SCENARIOS:
+        raise KeyError(
+            f"unknown scenario {name!r}; known: {sorted(SCENARIOS)}"
+        )
+    factory = SCENARIOS[name]
+    return factory(seed) if seed is not None else factory()

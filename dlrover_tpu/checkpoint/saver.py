@@ -256,7 +256,19 @@ class AsyncCheckpointSaver:
                     self._save_shard, step, local_rank, handler, step_dir
                 )
             )
-        ok = all(f.result() for f in futures)
+        # a shard whose storage write RAISES (IO fault, chaos
+        # injection) is a failed shard, not an escape past the
+        # persist-failure telemetry below
+        results = []
+        for f in futures:
+            try:
+                results.append(bool(f.result()))
+            except Exception:  # noqa: BLE001 - storage backends vary
+                logger.exception(
+                    "step %s: shard persist raised", step
+                )
+                results.append(False)
+        ok = all(results)
         if not ok:
             logger.error("step %s: some shards failed to persist", step)
             _PERSIST_ERRORS_TOTAL.inc(reason="shard_failed")

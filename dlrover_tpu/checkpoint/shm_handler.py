@@ -290,6 +290,12 @@ class SharedMemoryHandler:
             "memcpy_s": round(t_memcpy, 3),
             "bytes": total,
         }
+        # chaos hook: a corrupt_shm rule flips bytes of (or tears) the
+        # snapshot that was just published, so restore/persist paths
+        # must prove they reject or survive a damaged segment
+        from dlrover_tpu import chaos as _chaos
+
+        _chaos.fire("ckpt.shm_save", step=config.step, handler=self)
         logger.debug(
             "rank %s wrote %.1f MB checkpoint step %s to shm "
             "(fetch %.2fs, memcpy %.2fs)",

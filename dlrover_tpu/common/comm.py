@@ -23,7 +23,9 @@ frames from older peers still dispatch.
 """
 
 import io
+import os
 import pickle
+import random
 import socket
 import socketserver
 import struct
@@ -34,9 +36,52 @@ import uuid
 from collections import OrderedDict
 from typing import Optional
 
+from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common.constants import GRPC
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry import tracing as _tracing
+from dlrover_tpu.telemetry.metrics import get_registry as _get_registry
+
+_RPC_RETRIES_TOTAL = _get_registry().counter(
+    "dlrover_rpc_client_retries_total",
+    "Client roundtrips that failed and entered backoff, by verb",
+)
+_RPC_RECONNECTS_TOTAL = _get_registry().counter(
+    "dlrover_rpc_client_reconnects_total",
+    "TCP connections the client established (first + after drops)",
+)
+
+# reconnect-hardening knobs (chaos partition scenarios hammer this
+# path; prod defaults preserve the former envelope: 0.5 s doubling,
+# capped at 8 s)
+RPC_RETRIES_ENV = "DLROVER_RPC_RETRIES"
+RPC_BACKOFF_BASE_ENV = "DLROVER_RPC_BACKOFF_BASE"
+RPC_BACKOFF_MAX_ENV = "DLROVER_RPC_BACKOFF_MAX"
+
+
+def compute_backoff(
+    attempt: int,
+    base: float = 0.5,
+    cap: float = 8.0,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Jittered exponential backoff: ``base * 2**attempt`` capped at
+    ``cap``, with equal jitter (uniform over the upper half) so a
+    partition that drops N clients at once does not resynchronize them
+    into a reconnect stampede against a just-recovered master."""
+    # clamp the exponent BEFORE exponentiating: with env-tuned retry
+    # counts in the thousands (riding out a long partition), a bare
+    # 2.0**attempt overflows to OverflowError mid-retry-loop
+    b = min(base * (2.0 ** min(attempt, 60)), cap)
+    rng = rng or random
+    return b / 2.0 + rng.uniform(0.0, b / 2.0)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 _LEN = struct.Struct(">Q")
 _MAX_FRAME = GRPC.MAX_MESSAGE_BYTES
@@ -197,6 +242,18 @@ class _Connection(socketserver.BaseRequestHandler):
             try:
                 verb, node_id, node_type, req_id, message = frame[:5]
                 trace_ctx = frame[5] if len(frame) > 5 else None
+                try:
+                    # server-side chaos: a drop kills the connection
+                    # BEFORE dispatch, so the client's retry replays
+                    # the request against an intact handler (the
+                    # response cache covers the executed-but-unacked
+                    # case); a delay just stretches dispatch
+                    _chaos.fire(
+                        "rpc.server.dispatch",
+                        verb=verb, node_id=node_id,
+                    )
+                except ConnectionError:
+                    return
                 hit, resp = server.response_cache.get(req_id)
                 if not hit:
                     with _tracing.attach_context(trace_ctx):
@@ -294,13 +351,27 @@ class MessageClient:
         node_id: int = -1,
         node_type: str = "",
         timeout: float = 60.0,
-        retries: int = 10,
+        retries: Optional[int] = None,
+        backoff_base: Optional[float] = None,
+        backoff_max: Optional[float] = None,
     ):
         self._addr = addr
         self._node_id = node_id
         self._node_type = node_type
         self._timeout = timeout
-        self._retries = retries
+        self._retries = max(1, int(
+            retries if retries is not None
+            else _env_float(RPC_RETRIES_ENV, 10)
+        ))
+        self._backoff_base = (
+            backoff_base if backoff_base is not None
+            else _env_float(RPC_BACKOFF_BASE_ENV, 0.5)
+        )
+        self._backoff_max = (
+            backoff_max if backoff_max is not None
+            else _env_float(RPC_BACKOFF_MAX_ENV, 8.0)
+        )
+        self._rng = random.Random()
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
 
@@ -308,15 +379,30 @@ class MessageClient:
         host, port = self._addr.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _RPC_RECONNECTS_TOTAL.inc()
         return sock
 
     def _roundtrip(self, verb: str, message):
+        """One logical request with bounded, jittered-backoff retries.
+
+        Every attempt may fail at connect, send or receive — repeated
+        connect failures (master rescheduling, RPC partition) walk the
+        same exponential envelope as mid-stream drops, the sleep is
+        jittered so a partition's worth of clients cannot reconnect in
+        lockstep, and the final attempt raises immediately instead of
+        paying one more backoff it can never use."""
         last_err: Optional[Exception] = None
         # one id for all attempts: a retry of an executed-but-unacked
         # request is answered from the server's response cache
         req_id = uuid.uuid4().hex
         for attempt in range(self._retries):
             try:
+                # chaos hook: a drop/partition rule raises
+                # ConnectionError here and exercises exactly this
+                # retry path; a delay rule stretches the roundtrip
+                _chaos.fire(
+                    "rpc.client.roundtrip", verb=verb, addr=self._addr
+                )
                 with self._lock:
                     if self._sock is None:
                         self._sock = self._connect()
@@ -337,6 +423,7 @@ class MessageClient:
                 return resp
             except (ConnectionError, OSError) as e:
                 last_err = e
+                _RPC_RETRIES_TOTAL.inc(verb=verb)
                 with self._lock:
                     if self._sock is not None:
                         try:
@@ -344,14 +431,20 @@ class MessageClient:
                         except OSError:
                             pass
                         self._sock = None
-                backoff = min(0.5 * (2**attempt), 8.0)
+                if attempt + 1 >= self._retries:
+                    break
+                backoff = compute_backoff(
+                    attempt, self._backoff_base, self._backoff_max,
+                    self._rng,
+                )
                 logger.warning(
                     "connection to %s failed (%s); retry %d/%d in %.1fs",
                     self._addr, e, attempt + 1, self._retries, backoff,
                 )
                 time.sleep(backoff)
         raise ConnectionError(
-            f"cannot reach master at {self._addr}: {last_err}"
+            f"cannot reach master at {self._addr} after "
+            f"{self._retries} attempts: {last_err}"
         )
 
     def get(self, message):

@@ -1,7 +1,9 @@
 """Env accessors for the agent<->trainer contract (role of
-dlrover/python/common/env_utils.py)."""
+dlrover/python/common/env_utils.py), plus the shared /proc/<pid>/stat
+field parser the process-supervision paths rely on."""
 
 import os
+from typing import List, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
 
@@ -11,6 +13,22 @@ def _get_int(name: str, default: int = 0) -> int:
         return int(os.getenv(name, default))
     except (TypeError, ValueError):
         return default
+
+
+def proc_stat_fields(pid: int) -> Optional[List[bytes]]:
+    """Fields of ``/proc/<pid>/stat`` AFTER the comm field, or None
+    when the pid is gone.  comm (field 2) may itself contain spaces or
+    ``)``, so fields are split after the LAST ``)`` — index 0 is field
+    3 (state), index 1 is field 4 (ppid), index 19 is field 22
+    (starttime in clock ticks).  One parser for every consumer
+    (forkserver pid-reuse guard, chaos orphan scan) so the escaping
+    caveat lives in exactly one place."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        return data.rsplit(b")", 1)[1].split()
+    except (OSError, IndexError):
+        return None
 
 
 def get_node_id() -> int:

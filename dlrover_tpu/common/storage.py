@@ -14,6 +14,7 @@ import tempfile
 import threading
 from typing import List, Optional
 
+from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -98,6 +99,9 @@ class PosixDiskStorage(CheckpointStorage):
         self._deletion_strategy = deletion_strategy
 
     def write(self, content, path: str):
+        # chaos hook: an io_error rule raises OSError into the saver's
+        # per-shard error path; a stall rule models a hung NFS/disk
+        _chaos.fire("storage.write", path=path)
         mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
         os.makedirs(os.path.dirname(path), exist_ok=True)
         # write-to-temp + rename so readers never observe partial files
@@ -112,12 +116,14 @@ class PosixDiskStorage(CheckpointStorage):
             raise
 
     def read(self, path: str, mode: str = "rb"):
+        _chaos.fire("storage.read", path=path)
         if not os.path.exists(path):
             return None
         with open(path, mode) as f:
             return f.read()
 
     def safe_move(self, src: str, dst: str):
+        _chaos.fire("storage.move", path=dst)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         if os.path.exists(dst):
             self.safe_rmtree(dst)
@@ -172,6 +178,7 @@ class FsspecStorage(CheckpointStorage):
         self._deletion_strategy = deletion_strategy
 
     def write(self, content, path: str):
+        _chaos.fire("storage.write", path=path)
         mode = "wb" if isinstance(
             content, (bytes, bytearray, memoryview)
         ) else "w"

@@ -35,6 +35,7 @@ from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
 from dlrover_tpu.telemetry.events import emit_event, set_event_source
 from dlrover_tpu.telemetry.exporter import (
+    METRICS_AGGREGATE_ENV,
     METRICS_PORT_ENV,
     PrometheusEndpoint,
 )
@@ -105,7 +106,12 @@ class JobMaster:
         if metrics_port is not None:
             try:
                 self.metrics_endpoint = PrometheusEndpoint(
-                    port=int(metrics_port)
+                    port=int(metrics_port),
+                    # fold agent textfile dumps into every scrape so
+                    # one master scrape covers worker-side metrics
+                    aggregate_glob=os.getenv(
+                        METRICS_AGGREGATE_ENV, ""
+                    ),
                 )
                 self.aux_services.append(self.metrics_endpoint)
             except ValueError:

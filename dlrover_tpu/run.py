@@ -144,6 +144,11 @@ def run(args) -> int:
         master_proc, master_addr = _launch_local_master(max_nodes)
         logger.info("launched local master at %s", master_addr)
 
+    # remember the ambient value: when WE spawned the local master its
+    # address must not outlive it in this process's env, or the next
+    # in-process run (tests, the chaos harness) inherits a dead master
+    # and skips launching its own
+    prev_master_addr = os.environ.get(NodeEnv.MASTER_ADDR)
     os.environ[NodeEnv.MASTER_ADDR] = master_addr
     os.environ.setdefault(NodeEnv.NODE_ID, str(node_rank))
     os.environ.setdefault(NodeEnv.NODE_RANK, str(node_rank))
@@ -175,6 +180,12 @@ def run(args) -> int:
     finally:
         AsyncCheckpointSaver.stop_all()
         if master_proc is not None:
+            # the local master dies with this run: restore the env so
+            # a later run in this process cannot aim at its corpse
+            if prev_master_addr is None:
+                os.environ.pop(NodeEnv.MASTER_ADDR, None)
+            else:
+                os.environ[NodeEnv.MASTER_ADDR] = prev_master_addr
             master_proc.terminate()
             try:
                 master_proc.wait(timeout=10)

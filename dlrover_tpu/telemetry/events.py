@@ -23,6 +23,11 @@ import threading
 import time
 from typing import Dict, Iterator, Optional
 
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX
+    fcntl = None
+
 EVENT_SCHEMA_VERSION = 1
 EVENT_LOG_ENV = "DLROVER_EVENT_LOG"
 EVENT_LOG_MAX_BYTES_ENV = "DLROVER_EVENT_LOG_MAX_BYTES"
@@ -113,6 +118,29 @@ class TrainingEventExporter:
             return
         if size + incoming <= limit:
             return
+        # inter-process guard: master/agent/trainer all append to one
+        # log, and two processes crossing the size boundary together
+        # would both rotate — the second os.replace renaming a
+        # near-empty fresh file over the just-created backup, deleting
+        # up to max_bytes of history.  flock serializes the rotation;
+        # the loser re-checks the size and sees the already-fresh file.
+        if fcntl is None:
+            self._rotate(path)
+            return
+        try:
+            with open(f"{path}.lock", "a") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    return
+                if size + incoming <= limit:
+                    return  # another process already rotated
+                self._rotate(path)
+        except OSError:
+            self._rotate(path)  # lock unavailable: best effort
+
+    def _rotate(self, path: str):
         for i in range(self._backups, 0, -1):
             src = path if i == 1 else f"{path}.{i - 1}"
             try:

@@ -8,19 +8,164 @@
 - :class:`TextfileDumper`: agents (no stable scrape address under
   churn) periodically write the same exposition to a file for the
   node-exporter textfile collector to pick up.
+- :func:`aggregate_textfiles`: folds the agents' textfile dumps into
+  one exposition (every sample tagged ``agent="<file stem>"``); the
+  master's endpoint appends it when ``DLROVER_METRICS_AGGREGATE_GLOB``
+  points at the dump files, so ONE scrape of the master also covers
+  worker-side metrics — no per-agent scrape targets under churn.  The
+  chaos invariant checkers read worker metrics through the same
+  aggregation.
 """
 
+import glob as _glob
 import os
 import threading
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry import metrics as _metrics
 
 METRICS_PORT_ENV = "DLROVER_METRICS_PORT"
 METRICS_TEXTFILE_ENV = "DLROVER_METRICS_TEXTFILE"
+METRICS_AGGREGATE_ENV = "DLROVER_METRICS_AGGREGATE_GLOB"
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# sample-name suffixes that belong to their base metric family
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_families(
+    text: str,
+) -> "OrderedDict[str, Dict[str, object]]":
+    """Prometheus text exposition -> ordered
+    ``{family: {"help", "type", "samples": [raw line, ...]}}``.
+    Sample lines are kept verbatim; family attribution follows the
+    preceding ``# TYPE`` block, falling back to suffix stripping."""
+    fams: "OrderedDict[str, Dict[str, object]]" = OrderedDict()
+
+    def fam(name: str) -> Dict[str, object]:
+        return fams.setdefault(
+            name, {"help": "", "type": "", "samples": []}
+        )
+
+    current = ""
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_ = line[len("# HELP "):].partition(" ")
+            f = fam(name)
+            f["help"] = f["help"] or help_
+            current = name
+        elif line.startswith("# TYPE "):
+            name, _, type_ = line[len("# TYPE "):].partition(" ")
+            f = fam(name)
+            f["type"] = f["type"] or type_.strip()
+            current = name
+        elif line.startswith("#"):
+            continue
+        else:
+            brace = line.find("{")
+            space = line.find(" ")
+            end = brace if 0 <= brace < (
+                space if space >= 0 else len(line)
+            ) else space
+            sname = line[:end] if end >= 0 else line
+            family = sname
+            if sname not in fams:
+                if current and (
+                    sname == current
+                    or any(
+                        sname == current + sfx
+                        for sfx in _FAMILY_SUFFIXES
+                    )
+                ):
+                    family = current
+                else:
+                    for sfx in _FAMILY_SUFFIXES:
+                        if sname.endswith(sfx) and (
+                            sname[: -len(sfx)] in fams
+                        ):
+                            family = sname[: -len(sfx)]
+                            break
+            fam(family)["samples"].append(line)
+    return fams
+
+
+def _with_label(line: str, key: str, value: str) -> str:
+    """Inject ``key="value"`` into one raw sample line."""
+    escaped = (
+        value.replace("\\", "\\\\").replace('"', '\\"')
+    )
+    brace = line.find("{")
+    space = line.find(" ")
+    if 0 <= brace < (space if space >= 0 else len(line)):
+        close = line.rfind("}")
+        if close < 0:
+            return line
+        inner = line[brace + 1:close].strip()
+        sep = "," if inner else ""
+        return (
+            line[:close] + f'{sep}{key}="{escaped}"' + line[close:]
+        )
+    if space < 0:
+        return line
+    return f'{line[:space]}{{{key}="{escaped}"}}{line[space:]}'
+
+
+def _render_families(fams) -> str:
+    lines: List[str] = []
+    for name, f in fams.items():
+        if f["help"]:
+            lines.append(f"# HELP {name} {f['help']}")
+        if f["type"]:
+            lines.append(f"# TYPE {name} {f['type']}")
+        lines.extend(f["samples"])
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def aggregate_textfiles(pattern: str) -> str:
+    """Merge every textfile dump matching ``pattern`` into one
+    exposition; each file's samples get an ``agent="<stem>"`` label so
+    same-named worker series never collide across agents."""
+    fams: "OrderedDict[str, Dict[str, object]]" = OrderedDict()
+    for path in sorted(_glob.glob(pattern)):
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            logger.debug("cannot read textfile dump %s: %s", path, e)
+            continue
+        stem = os.path.splitext(os.path.basename(path))[0]
+        for name, parsed in _parse_families(text).items():
+            merged = fams.setdefault(
+                name, {"help": "", "type": "", "samples": []}
+            )
+            merged["help"] = merged["help"] or parsed["help"]
+            merged["type"] = merged["type"] or parsed["type"]
+            merged["samples"].extend(
+                _with_label(line, "agent", stem)
+                for line in parsed["samples"]
+            )
+    return _render_families(fams)
+
+
+def merge_expositions(primary: str, *others: str) -> str:
+    """Concatenate expositions family-wise: one HELP/TYPE per family,
+    samples appended in order.  Callers are responsible for label
+    disambiguation (``aggregate_textfiles`` already tags its samples)."""
+    fams = _parse_families(primary)
+    for text in others:
+        for name, parsed in _parse_families(text).items():
+            merged = fams.setdefault(
+                name, {"help": "", "type": "", "samples": []}
+            )
+            merged["help"] = merged["help"] or parsed["help"]
+            merged["type"] = merged["type"] or parsed["type"]
+            merged["samples"].extend(parsed["samples"])
+    return _render_families(fams)
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
@@ -31,7 +176,21 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         if self.path.split("?")[0] not in ("/metrics", "/"):
             self.send_error(404)
             return
-        body = registry.render_prometheus().encode()
+        text = registry.render_prometheus()
+        pattern = (
+            getattr(self.server, "aggregate_glob", "")
+            or os.environ.get(METRICS_AGGREGATE_ENV, "")
+        )
+        if pattern:
+            try:
+                text = merge_expositions(
+                    text, aggregate_textfiles(pattern)
+                )
+            except Exception as e:  # noqa: BLE001 - never fail a scrape
+                logger.warning(
+                    "agent textfile aggregation failed: %s", e
+                )
+        body = text.encode()
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
@@ -51,10 +210,16 @@ class PrometheusEndpoint:
         port: int = 0,
         host: str = "0.0.0.0",
         registry: Optional[_metrics.MetricsRegistry] = None,
+        aggregate_glob: str = "",
     ):
+        """``aggregate_glob``: glob of agent textfile dumps folded
+        into every scrape response (one master scrape covers
+        worker-side metrics); defaults to
+        ``DLROVER_METRICS_AGGREGATE_GLOB`` at request time."""
         self._requested_port = port
         self._host = host
         self._registry = registry or _metrics.get_registry()
+        self._aggregate_glob = aggregate_glob
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port = 0
@@ -79,6 +244,9 @@ class PrometheusEndpoint:
             return
         self._server.daemon_threads = True
         self._server.registry = self._registry  # type: ignore[attr-defined]
+        self._server.aggregate_glob = (  # type: ignore[attr-defined]
+            self._aggregate_glob
+        )
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever,
@@ -132,9 +300,16 @@ class TextfileDumper:
             self._thread.start()
 
     def _run(self):
+        self.dump_once()  # a dump exists from the start, not at t+15s
         while not self._stopped.wait(self._interval):
             self.dump_once()
         self.dump_once()  # final flush so short runs leave a dump
 
     def stop(self):
         self._stopped.set()
+        # wait for the final flush: without the join a short-lived
+        # agent can exit (killing the daemon thread) before the dump
+        # lands, leaving no .prom file at all
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

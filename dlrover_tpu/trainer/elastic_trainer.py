@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common import env_utils, jax_compat
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.parallel.mesh import dp_world_size
@@ -32,6 +33,7 @@ from dlrover_tpu.parallel.sharding import (
     batch_spec,
     sharding_tree,
 )
+from dlrover_tpu.telemetry.events import emit_event
 from dlrover_tpu.telemetry.metrics import get_registry
 
 _REG = get_registry()
@@ -170,6 +172,7 @@ class ElasticTrainer:
             os.path.join("/tmp", f"dlrover_metrics_{os.getuid()}.json"),
         )
         self._epoch = 0
+        self._restart_count = env_utils.get_restart_count()
         _GRAD_ACCUM_GAUGE.set(self.grad_accum)
         logger.info(
             "elastic trainer: global_batch=%s micro=%s dp=%s accum=%s",
@@ -188,6 +191,18 @@ class ElasticTrainer:
         monitor/training.py)."""
         self.global_step += 1
         _REPORTED_STEP.set(self.global_step)
+        # per-step training event: this is what lets the chaos
+        # invariant checkers compute "steps lost across a fault" from
+        # the event log alone (no-op unless an event log is configured)
+        emit_event(
+            "train_step",
+            step=self.global_step,
+            restart_count=self._restart_count,
+        )
+        # chaos hook AFTER the event: a kill rule at step N must leave
+        # step N's completion in the log before the process dies; a
+        # slow rule stretches the observable step time (straggler)
+        _chaos.fire("trainer.step", step=self.global_step)
         record = {
             "global_step": self.global_step,
             "timestamp": time.time(),

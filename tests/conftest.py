@@ -27,17 +27,32 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    """Register the suite's custom markers (no pytest.ini in this
+    repo): ``chaos`` tags fault-injection tests so they are runnable
+    as a family (``-m chaos``); ``slow`` tags long scenarios tier-1
+    excludes (the verify command runs ``-m 'not slow'``)."""
+    config.addinivalue_line(
+        "markers", "chaos: chaos fault-injection tests"
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from tier-1 verify",
+    )
+
+
 def pytest_collection_modifyitems(config, items):
-    """Run the stdlib-only telemetry unit tests before the jit/e2e
-    heavyweights.  On a slow box a wall-clock-bounded CI window can
-    truncate the (alphabetical) tail of the suite; these tests cost
-    milliseconds, must never be the ones dropped (every other
-    subsystem now records through the registry they verify), and are
-    side-effect-free first (they only touch fresh registry/exporter
-    instances or clear the global tracer themselves)."""
+    """Run the stdlib-only telemetry + chaos unit tests before the
+    jit/e2e heavyweights.  On a slow box a wall-clock-bounded CI
+    window can truncate the (alphabetical) tail of the suite; these
+    tests cost milliseconds-to-seconds, must never be the ones dropped
+    (every other subsystem records through the registry/hooks they
+    verify), and are side-effect-free first (fresh registry/exporter/
+    injector instances, cleaned up by their own fixtures)."""
+    early_files = ("test_telemetry.py", "test_chaos.py")
     early = [
         it for it in items
-        if it.nodeid.split("::", 1)[0].endswith("test_telemetry.py")
+        if it.nodeid.split("::", 1)[0].endswith(early_files)
     ]
     if early:
         rest = [it for it in items if it not in early]

@@ -1,0 +1,114 @@
+"""Chaos e2e (ISSUE 2 acceptance): a seeded kill of the training
+worker mid-step drives the REAL recovery machinery — agent monitor
+loop, breakpoint shm persist, master re-rendezvous, worker respawn,
+flash restore — and the invariant checkers verify recovery from the
+telemetry event log alone.  The long/bulk scenarios are ``slow``; the
+deterministic-seed kill scenario is the tier-1 regression net."""
+
+import pytest
+
+from dlrover_tpu.chaos import harness, scenarios
+from dlrover_tpu.checkpoint.saver import read_last_checkpoint
+
+pytestmark = pytest.mark.chaos
+
+TOTAL_STEPS = 8
+CKPT_EVERY = 2
+
+
+def _run(tmp_path, scenario, **kwargs):
+    return harness.run_scenario(
+        scenario,
+        workdir=str(tmp_path / "run"),
+        total_steps=TOTAL_STEPS,
+        ckpt_every=CKPT_EVERY,
+        monitor_interval=0.3,
+        **kwargs,
+    )
+
+
+def test_kill_worker_midstep_recovers(tmp_path):
+    """Acceptance: kill one worker mid-step with a fixed seed →
+    rendezvous reconverges, training resumes from the shm checkpoint
+    losing ≤ 1 checkpoint interval, final step commits, nothing is
+    orphaned — all verified from telemetry events."""
+    scenario = scenarios.kill_worker_midstep(seed=42)
+    # narrow the window to the shortened step budget
+    scenario.rules[0].step_window = [3, 6]
+    report = _run(tmp_path, scenario)
+    assert report.ok, report.summary()
+
+    # exactly one seeded kill, mid-step, in the window
+    assert len(report.timeline) == 1, report.timeline
+    seq, point, rule, action, step = report.timeline[0]
+    assert point == "trainer.step" and action == "kill"
+    assert 3 <= step <= 6
+
+    # the run really finished: last committed checkpoint on storage
+    # is the final step
+    final_step, shards = read_last_checkpoint(
+        str(tmp_path / "run" / "ckpt")
+    )
+    assert final_step == TOTAL_STEPS and 0 in shards
+
+
+@pytest.mark.slow
+def test_kill_scenario_timeline_deterministic_across_runs(tmp_path):
+    """Same scenario + same seed twice → byte-identical fault
+    timelines (CI satellite).  Two full mini-cluster runs, so slow."""
+    scenario = scenarios.kill_worker_midstep(seed=1234)
+    scenario.rules[0].step_window = [3, 6]
+    first = _run(tmp_path / "a", scenario)
+    assert first.ok, first.summary()
+    second = _run(
+        tmp_path / "b", scenario,
+        invariants=harness.default_invariants(
+            TOTAL_STEPS, CKPT_EVERY, str(tmp_path / "b" / "run")
+        ) + [harness.DeterministicTimeline(first.timeline)],
+    )
+    assert second.ok, second.summary()
+    assert second.timeline == first.timeline
+
+
+@pytest.mark.slow
+def test_rpc_partition_survived_by_backoff(tmp_path):
+    """A 2 s full RPC partition early in the run: the hardened
+    reconnect path rides it out; the job completes with no restart
+    and no steps lost."""
+    report = _run(
+        tmp_path,
+        scenarios.rpc_partition(seed=7),
+        invariants=[
+            harness.TrainingCompleted(total_steps=TOTAL_STEPS),
+            harness.NoOrphanProcesses(
+                marker=str(tmp_path / "run")
+            ),
+        ],
+    )
+    assert report.rc == 0, report.summary()
+    assert all(r.ok for r in report.invariants), report.summary()
+    # the partition really dropped frames
+    assert any(t[3] == "drop" for t in report.timeline), (
+        report.timeline
+    )
+
+
+@pytest.mark.slow
+def test_storage_brownout_degrades_and_recovers(tmp_path):
+    """First persist attempts fail with injected IO errors: the saver
+    reports the failure through telemetry (no silent loss) and a later
+    interval still commits; the job completes."""
+    report = _run(
+        tmp_path,
+        scenarios.storage_brownout(seed=11),
+        invariants=[
+            harness.TrainingCompleted(total_steps=TOTAL_STEPS),
+            harness.NoOrphanProcesses(
+                marker=str(tmp_path / "run")
+            ),
+        ],
+    )
+    assert report.rc == 0, report.summary()
+    assert all(r.ok for r in report.invariants), report.summary()
+    injected = [t for t in report.timeline if t[3] == "io_error"]
+    assert injected, report.timeline

@@ -65,8 +65,11 @@ def test_nice_boost_applied_then_reverted(srv, tmp_path):
         "time.sleep(2.0)\n"
         "p1 = os.getpriority(os.PRIO_PROCESS, 0)\n"
         "t.join()\n"
-        f"open({str(out)!r}, 'w').write(\n"
+        # write-to-temp + rename: the parent polls for the file and a
+        # non-atomic write races its read on a loaded box
+        f"open({str(out)!r} + '.tmp', 'w').write(\n"
         "    f'{p0} {p1} {res[\"t0\"]} {res[\"t1\"]}')\n"
+        f"os.replace({str(out)!r} + '.tmp', {str(out)!r})\n"
     )
     h = srv.spawn(
         [str(script)], {}, timeout=30.0,
@@ -195,6 +198,50 @@ def test_pid_recycle_guard_uses_start_time(srv, tmp_path):
         srv._pid_start[h.pid] = 1  # no real process started at tick 1
     assert srv.exit_code(h.pid) == -1  # treated as exited
     os.kill(h.pid, signal.SIGKILL)
+
+
+@pytest.mark.chaos
+def test_rapid_kill_respawn_prunes_bookkeeping(srv, tmp_path):
+    """ISSUE 2 satellite: hammer the spawn path with the chaos kill
+    primitive — every round SIGKILLs the fresh worker immediately and
+    respawns.  Across rounds (1) every recorded spawn start time
+    matches the live /proc snapshot (the pid-reuse guard's raw
+    material stays truthful), (2) consuming the exit prunes ALL
+    per-pid maps, so a long-lived agent cannot accumulate an entry per
+    incarnation, and (3) no round's death is ever missed."""
+    from dlrover_tpu.chaos import kill_process
+
+    script = tmp_path / "victim.py"
+    script.write_text("import time\ntime.sleep(600)\n")
+    seen_pids = []
+    for _ in range(5):
+        h = srv.spawn([str(script)], {}, timeout=30.0)
+        seen_pids.append(h.pid)
+        # start-time bookkeeping recorded and truthful at spawn
+        assert srv._pid_start[h.pid] == srv._proc_start_time(h.pid)
+        assert kill_process(h.pid, signal.SIGKILL)
+        code = h.wait(timeout=20.0)  # death observed, never missed
+        assert code is not None and code != 0
+        # the handle consumed the exit: per-pid maps fully pruned
+        with srv._lock:
+            assert h.pid not in srv._exits
+            assert h.pid not in srv._pid_generation
+            assert h.pid not in srv._pid_start
+            assert h.pid not in srv._spawned
+    # after the storm the server is byte-for-byte back to empty
+    with srv._lock:
+        assert srv._exits == {}
+        assert srv._pid_generation == {}
+        assert srv._pid_start == {}
+        assert srv._spawned == []
+    # a recycled-looking pid (stale generation + mismatched start
+    # time) is reported dead instead of trusted as alive
+    h = srv.spawn([str(script)], {}, timeout=30.0)
+    with srv._lock:
+        srv._pid_generation[h.pid] = srv._generation - 1
+        srv._pid_start[h.pid] = 1
+    assert srv.exit_code(h.pid) == -1
+    kill_process(h.pid, signal.SIGKILL)
 
 
 def test_proc_start_time_none_for_dead_pid(srv, tmp_path):

@@ -327,6 +327,70 @@ def test_textfile_dumper(tmp_path):
     assert "dlrover_workers 3" in out.read_text()
 
 
+def test_aggregate_textfiles_tags_and_merges(tmp_path):
+    """ISSUE 2 satellite: agent textfile dumps fold into one
+    exposition — a single HELP/TYPE per family, every sample tagged
+    with its agent, identical series from two agents disambiguated."""
+    from dlrover_tpu.telemetry.exporter import aggregate_textfiles
+
+    for name in ("node0", "node1"):
+        reg = MetricsRegistry()
+        reg.counter(
+            "dlrover_agent_worker_restarts_total", "restarts"
+        ).inc(2)
+        reg.histogram("dlrover_agent_rdzv_seconds", "rdzv").observe(
+            0.2, rdzv="elastic-training"
+        )
+        (tmp_path / f"{name}.prom").write_text(
+            reg.render_prometheus()
+        )
+    merged = aggregate_textfiles(str(tmp_path / "*.prom"))
+    assert merged.count(
+        "# TYPE dlrover_agent_worker_restarts_total counter"
+    ) == 1
+    assert merged.count("# TYPE dlrover_agent_rdzv_seconds") == 1
+    assert (
+        'dlrover_agent_worker_restarts_total{agent="node0"} 2'
+        in merged
+    )
+    assert (
+        'dlrover_agent_worker_restarts_total{agent="node1"} 2'
+        in merged
+    )
+    # histogram child samples keep their labels AND gain the agent tag
+    assert (
+        'rdzv="elastic-training"' in merged
+        and 'agent="node1"' in merged
+    )
+
+
+def test_endpoint_aggregates_agent_dumps(tmp_path):
+    """One scrape of the master endpoint covers worker-side metrics
+    when DLROVER_METRICS_AGGREGATE_GLOB-style aggregation is wired."""
+    agent_reg = MetricsRegistry()
+    agent_reg.gauge("dlrover_trainer_reported_step").set(17)
+    (tmp_path / "agent0.prom").write_text(
+        agent_reg.render_prometheus()
+    )
+    master_reg = MetricsRegistry()
+    master_reg.counter("dlrover_rdzv_join_total", "joins").inc(1)
+    ep = PrometheusEndpoint(
+        port=0, host="127.0.0.1", registry=master_reg,
+        aggregate_glob=str(tmp_path / "*.prom"),
+    )
+    ep.start()
+    try:
+        url = f"http://127.0.0.1:{ep.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = resp.read().decode()
+        assert "dlrover_rdzv_join_total 1" in body
+        assert (
+            'dlrover_trainer_reported_step{agent="agent0"} 17' in body
+        )
+    finally:
+        ep.stop()
+
+
 def test_master_starts_metrics_endpoint(monkeypatch):
     from dlrover_tpu.master.master import JobMaster
 
