@@ -120,6 +120,26 @@ def _round_finite(x, digits: int = 4):
         else None
 
 
+# set by _child_main: when a section runs in its own subprocess this
+# is the child's process-start time, so budget-aware sections can
+# compute how long they have before the parent's SIGKILL lands
+_CHILD_T0 = None
+
+
+def _section_remaining() -> float:
+    """Seconds left before this section child's budget SIGKILL —
+    inf when not running as a budgeted child.  Lets long sections
+    (xl_act_offload) finish cleanly with an explicit partial result
+    instead of dying mid-leg and landing in "skipped"."""
+    try:
+        budget = float(os.getenv("BENCH_SECTION_BUDGET_S", "") or 0.0)
+    except ValueError:
+        budget = 0.0
+    if budget <= 0 or _CHILD_T0 is None:
+        return float("inf")
+    return budget - (time.time() - _CHILD_T0)
+
+
 def _flops_per_token(cfg, n_params: int, seq: int) -> float:
     """PaLM-appendix accounting: 6N per token for the matmuls plus
     the causal-attention term 12 * L * seq * hidden."""
@@ -417,7 +437,26 @@ def bench_xl_act_offload(jax, results: dict):
     # offload leg even when the control leg's kill arrives
     out = {"model": "gpt2_xl", "seq_len": seq2, "batch": batch2}
     results["xl_act_offload"] = out
+    t_leg = time.time()
     out["offload"] = try_xl(seq2, batch2, "offload")
+    leg_s = time.time() - t_leg
+    # budget-aware: the control leg costs about what the offload leg
+    # did (same model, same compile pipeline).  If it cannot finish
+    # before the subprocess SIGKILL, record an explicit partial
+    # result and exit cleanly — a half-run leg's numbers would be
+    # lost at the kill anyway, and "partial": true keeps the section
+    # out of the headline's "skipped" list
+    rem = _section_remaining()
+    est = leg_s * 1.2 + 30.0
+    if rem < est:
+        out["plain_remat_control"] = {
+            "ok": False,
+            "skipped": (
+                f"budget: {rem:.0f}s left < ~{est:.0f}s control leg"
+            ),
+        }
+        out["partial"] = True
+        return
     out["plain_remat_control"] = try_xl(seq2, batch2, "full")
 
 
@@ -1267,12 +1306,14 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
         # disk latency as the shm recovery number
         shm_config, _shm_state = engine.get_state_dict_from_memory()
         restore_shm_s = time.perf_counter() - t0
+        restore_shm_phases = dict(engine.last_restore_phases)
         assert shm_config is not None and shm_config.step >= 2, (
             "shm snapshot unreadable - shm restore not measured"
         )
         t0 = time.perf_counter()
         step, restored = engine.load_from_storage()
         restore_disk_s = time.perf_counter() - t0
+        restore_disk_phases = dict(engine.last_restore_phases)
         assert step == committed >= 2, (
             f"persisted step {step} != committed {committed}"
         )
@@ -1300,7 +1341,14 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
         "restore_shm_MBps": round(
             state_bytes / 2**20 / max(restore_shm_s, 1e-9), 1
         ),
+        # per-stage pipeline breakdown (read / assemble / h2d) of each
+        # restore tier — the recovery-side twin of save_phases
+        "restore_shm_phases": restore_shm_phases,
         "restore_disk_s": round(restore_disk_s, 4),
+        "restore_disk_MBps": round(
+            state_bytes / 2**20 / max(restore_disk_s, 1e-9), 1
+        ),
+        "restore_disk_phases": restore_disk_phases,
         "save_phases": dict(engine.last_save_phases),
         "state_mb": round(state_bytes / 2**20, 1),
         "num_params": count_params(params),
@@ -1944,9 +1992,21 @@ def _headline(snapshot: dict) -> dict:
         if k.endswith("_note")
         and ("skipped" in str(snapshot[k])
              or "killed" in str(snapshot[k]))
+        # a section that emitted a partial result is reported under
+        # partial_sections, not written off as skipped
+        and not (
+            isinstance(snapshot.get(k[: -len("_note")]), dict)
+            and snapshot[k[: -len("_note")]].get("partial")
+        )
     )
     if notes:
         h["skipped"] = notes
+    partials = sorted(
+        name for name, val in snapshot.items()
+        if isinstance(val, dict) and val.get("partial")
+    )
+    if partials:
+        h["partial_sections"] = partials
     return h
 
 
@@ -2054,7 +2114,9 @@ def _child_main(name: str, state_path: str, workdir: str) -> int:
     SIGKILL (or a mid-section crash) still leaves every completed
     sub-measurement for the parent to merge — os.replace keeps the
     out-file a consistent snapshot at all times."""
+    global _CHILD_T0
     t0 = time.time()
+    _CHILD_T0 = t0
     import jax
 
     _enable_compile_cache(jax)
@@ -2211,6 +2273,16 @@ def main() -> int:
                          state_path, workdir],
                         stdout=lf, stderr=lf, cwd=os.getcwd(),
                         start_new_session=True,
+                        # budget-aware sections read this to finish
+                        # with a partial result before the SIGKILL;
+                        # REMAINING budget, not the nominal one — a
+                        # retry attempt starts with whatever attempt
+                        # 1 left, and overstating it would let the
+                        # child start a leg the parent kills mid-run
+                        env={
+                            **os.environ,
+                            "BENCH_SECTION_BUDGET_S": f"{max(5.0, budget - (time.time() - t0)):.0f}",
+                        },
                     ))
                 killed = False
                 try:
@@ -2236,6 +2308,13 @@ def main() -> int:
                     results.pop(name + "_error", None)
                     return
                 if killed:
+                    # sub-measurements the child dumped before the
+                    # kill are real results — mark the section partial
+                    # so the headline reports it as such instead of
+                    # filing it under "skipped"
+                    sec = results.get(name)
+                    if isinstance(sec, dict) and sec:
+                        sec["partial"] = True
                     return  # budget exhausted — no retry
                 tail = ""
                 try:

@@ -35,6 +35,9 @@ from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.chaos.scenarios import (
     CHAOS_TRAIN_SCRIPT,
     CKPT_EVERY_ENV,
+    DISK_EVERY_ENV,
+    RUN_OPTIONS,
+    STEP_SLEEP_ENV,
     TOTAL_STEPS_ENV,
 )
 from dlrover_tpu.chaos.schedule import Scenario, load_scenario
@@ -268,6 +271,49 @@ class DeterministicTimeline(Invariant):
         )
 
 
+class RestoredFromTier(Invariant):
+    """The first post-fault restore came from the expected tier —
+    e.g. a torn/corrupted shm snapshot must be refused and recovery
+    must fall back to the storage tier.  Decided entirely from the
+    ``checkpoint_restore`` event's ``tier`` field (shm / storage /
+    orbax), which the engine stamps on every successful restore."""
+
+    name = "restored_from_tier"
+
+    def __init__(self, tier: str):
+        self.tier = tier
+
+    def check(self, events, run):
+        fault_ts = _first_fault_ts(events)
+        if fault_ts is None:
+            return InvariantResult(
+                self.name, False, "no chaos_inject event recorded"
+            )
+        restores = [
+            e for e in events
+            if e.get("type") == "checkpoint_restore"
+            and e["ts"] >= fault_ts
+        ]
+        if not restores:
+            return InvariantResult(
+                self.name, False,
+                "no checkpoint_restore event after the fault",
+            )
+        tiers = [e.get("tier") for e in restores]
+        if tiers[0] != self.tier:
+            return InvariantResult(
+                self.name, False,
+                f"first post-fault restore came from tier "
+                f"{tiers[0]!r}, expected {self.tier!r} "
+                f"(all: {tiers})",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"restored from {self.tier!r} tier (step "
+            f"{restores[0].get('step')})",
+        )
+
+
 class NoOrphanProcesses(Invariant):
     """No process whose cmdline or environment references the job's
     workdir survives the run — catches leaked trainers, forkserver
@@ -452,8 +498,25 @@ RECOVERY_SCENARIOS = frozenset({
 
 
 def invariants_for_scenario(
-    name: str, total_steps: int, ckpt_every: int, workdir: str
+    name: str, total_steps: int, ckpt_every: int, workdir: str,
+    disk_every: Optional[int] = None,
 ) -> List[Invariant]:
+    if name == "shm-corrupt-storage-fallback":
+        # full recovery trail PLUS the tier assertion; step loss is
+        # bounded by the DISK interval (the shm interval's snapshot
+        # was deliberately torn).  ``disk_every`` is the interval the
+        # run ACTUALLY used (run_scenario passes its resolved value);
+        # standalone callers fall back to the scenario's RUN_OPTIONS
+        if not disk_every:
+            disk_every = RUN_OPTIONS.get(name, {}).get("disk_every", 0)
+        return [
+            WorkerRestarted(),
+            RendezvousReconverged(),
+            BoundedStepLoss(ckpt_interval=max(ckpt_every, disk_every)),
+            RestoredFromTier("storage"),
+            TrainingCompleted(total_steps=total_steps),
+            NoOrphanProcesses(marker=workdir),
+        ]
     if name in RECOVERY_SCENARIOS:
         return default_invariants(total_steps, ckpt_every, workdir)
     return [
@@ -471,13 +534,26 @@ def run_scenario(
     monitor_interval: float = 0.3,
     warm_restart: bool = False,
     invariants: Optional[List[Invariant]] = None,
+    disk_every: Optional[int] = None,
+    step_sleep: Optional[float] = None,
+    extra_env: Optional[Dict[str, str]] = None,
 ) -> ChaosRunReport:
     """Run ``scenario`` against a fresh single-node mini-cluster under
     ``workdir`` and evaluate the invariants.  With ``invariants=None``
     the set is chosen by scenario name (recovery scenarios get the
     full restart trail, ride-it-out scenarios completion+no-orphans);
-    pass ``invariants=[]`` to skip checking entirely."""
+    pass ``invariants=[]`` to skip checking entirely.
+
+    ``disk_every`` (durable mid-run saves), ``step_sleep`` (stretch
+    the toy loop for wall-clock windows) and ``extra_env`` default to
+    the scenario's entry in :data:`scenarios.RUN_OPTIONS`, so named
+    scenarios run correctly from the CLI and tests alike."""
     scenario = load_scenario(scenario)
+    opts = RUN_OPTIONS.get(scenario.name, {})
+    if disk_every is None:
+        disk_every = int(opts.get("disk_every", 0))
+    if step_sleep is None:
+        step_sleep = float(opts.get("step_sleep", 0.0))
     os.makedirs(workdir, exist_ok=True)
     spec_path = os.path.join(workdir, "chaos_scenario.json")
     with open(spec_path, "w") as f:
@@ -500,6 +576,13 @@ def run_scenario(
         # means "spawn a fresh local master"
         "DLROVER_MASTER_ADDR": "",
     }
+    if disk_every:
+        env[DISK_EVERY_ENV] = str(disk_every)
+    if step_sleep:
+        env[STEP_SLEEP_ENV] = str(step_sleep)
+    env.update(opts.get("extra_env", {}))
+    if extra_env:
+        env.update(extra_env)
     argv = [
         "--nproc_per_node=1",
         f"--max_restarts={max_restarts}",
@@ -535,7 +618,8 @@ def run_scenario(
     checks = (
         invariants if invariants is not None
         else invariants_for_scenario(
-            scenario.name, total_steps, ckpt_every, workdir
+            scenario.name, total_steps, ckpt_every, workdir,
+            disk_every=disk_every,
         )
     )
     for inv in checks:

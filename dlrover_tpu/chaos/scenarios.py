@@ -15,6 +15,14 @@ from dlrover_tpu.chaos.schedule import Scenario
 # knobs the harness exports to the training subprocess
 TOTAL_STEPS_ENV = "DLROVER_CHAOS_TOTAL_STEPS"
 CKPT_EVERY_ENV = "DLROVER_CHAOS_CKPT_EVERY"
+# durable mid-run saves every N steps (0 = only the final step goes
+# to disk) — the tier-fallback scenarios restore from these when the
+# shm snapshot is refused
+DISK_EVERY_ENV = "DLROVER_CHAOS_DISK_EVERY"
+# per-step sleep stretching the toy loop's wall clock so wall-time
+# triggered rules (preemption notices, brownout windows) land
+# mid-run instead of after the job already finished
+STEP_SLEEP_ENV = "DLROVER_CHAOS_STEP_SLEEP"
 
 # Toy GPT elastic train loop (mirrors bench.py's ELASTIC_TRAIN_SCRIPT
 # shape, minus the self-inflicted crash — faults come exclusively from
@@ -39,6 +47,17 @@ from dlrover_tpu.trainer.elastic_trainer import (
 ckpt_dir = sys.argv[1]
 TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "10"))
 CKPT_EVERY = int(os.environ.get("DLROVER_CHAOS_CKPT_EVERY", "2"))
+DISK_EVERY = int(os.environ.get("DLROVER_CHAOS_DISK_EVERY", "0"))
+STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
+
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+
+def committed_step():
+    try:
+        with open(tracker) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
 
 cfg = GPTConfig.tiny()
 model = GPT(cfg)
@@ -70,24 +89,42 @@ for i in range(start_step, TOTAL_STEPS):
     # report_step emits the train_step event and fires the
     # trainer.step chaos hook — a kill rule ends the process HERE
     trainer.report_step(metrics)
-    if trainer.global_step % CKPT_EVERY == 0:
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+    sd = {"params": state.params, "trainer": trainer.state_dict()}
+    if DISK_EVERY and trainer.global_step % DISK_EVERY == 0:
+        # durable mid-run save; wait for the commit so a kill rule
+        # scheduled a couple of steps later deterministically finds
+        # a committed storage step to fall back to
         ckpt.save_checkpoint(
-            trainer.global_step,
-            {"params": state.params, "trainer": trainer.state_dict()},
-            storage_type=StorageType.MEMORY,
+            trainer.global_step, sd, storage_type=StorageType.DISK,
+        )
+        ckpt.wait()
+        deadline = time.time() + 30
+        while (time.time() < deadline
+               and committed_step() < trainer.global_step):
+            time.sleep(0.1)
+    elif trainer.global_step % CKPT_EVERY == 0:
+        ckpt.save_checkpoint(
+            trainer.global_step, sd, storage_type=StorageType.MEMORY,
         )
 
-ckpt.save_checkpoint(
-    TOTAL_STEPS,
-    {"params": state.params, "trainer": trainer.state_dict()},
-    storage_type=StorageType.DISK,
-)
-ckpt.wait()
-tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+# final durable save, retried until the commit lands: a transient
+# brownout may eat one persist round (reported through telemetry,
+# never retried by the saver itself — the next SAVE event is the
+# retry), and the job's contract is that the final step ends up
+# committed anyway
+final_sd = {"params": state.params, "trainer": trainer.state_dict()}
 deadline = time.time() + 60
-while time.time() < deadline and not os.path.exists(tracker):
-    time.sleep(0.2)
-assert os.path.exists(tracker), "checkpoint commit did not land"
+while time.time() < deadline and committed_step() < TOTAL_STEPS:
+    ckpt.save_checkpoint(
+        TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+    )
+    ckpt.wait()
+    poll_end = time.time() + 10
+    while time.time() < poll_end and committed_step() < TOTAL_STEPS:
+        time.sleep(0.2)
+assert committed_step() >= TOTAL_STEPS, "checkpoint commit did not land"
 ckpt.close()
 '''
 
@@ -210,6 +247,73 @@ def preemption_notice(seed: int = 3) -> Scenario:
     })
 
 
+def shm_corrupt_storage_fallback(seed: int = 23) -> Scenario:
+    """Tier-fallback acceptance: tear the shm snapshot at a MEMORY
+    save, then kill the worker one step later.  The respawned trainer
+    must refuse the torn shm tier and restore from the last committed
+    storage step (the harness runs this with ``disk_every=4`` so one
+    exists) — asserted by the ``RestoredFromTier`` invariant reading
+    the ``checkpoint_restore`` event's ``tier`` field."""
+    return Scenario.from_dict({
+        "name": "shm-corrupt-storage-fallback",
+        "seed": seed,
+        "rules": [
+            {
+                "name": "torn-snapshot",
+                "point": "ckpt.shm_save",
+                "action": "corrupt_shm",
+                "at_step": 6,
+                "only_first_incarnation": True,
+                "args": {"mode": "torn"},
+            },
+            {
+                "name": "kill-after-tear",
+                "point": "trainer.step",
+                "action": "kill",
+                "at_step": 7,
+                "only_first_incarnation": True,
+            },
+        ],
+    })
+
+
+def ckpt_brownout_during_preemption(seed: int = 19) -> Scenario:
+    """ROADMAP scenario: a storage brownout lands exactly while a
+    preemption notice's grace-period breakpoint save is trying to
+    persist — the two grace paths compete for the persist executor.
+    The job must ride it out: the failed persist is REPORTED
+    (``checkpoint_persist`` ok=false event + error counter), later
+    saves commit, training completes, nothing deadlocks.  Wall-clock
+    triggered (the notice is a timer by nature), so the timeline is
+    bounded, not byte-stable; the harness stretches the toy loop with
+    ``step_sleep`` so the window lands mid-run."""
+    return Scenario.from_dict({
+        "name": "ckpt-brownout-during-preemption",
+        "seed": seed,
+        "rules": [
+            {
+                "name": "notice",
+                "point": "preemption.probe",
+                "action": "preempt",
+                "after_time": 5.0,
+            },
+            {
+                # exactly one injected failure, on the FIRST storage
+                # write of the job — MEMORY saves never touch storage,
+                # so that write is a grace-path persist (the notice's
+                # breakpoint save when the snapshot beat the notice,
+                # else the final commit's first round, which the toy
+                # loop re-issues) — then the fault is spent so the
+                # retried commit goes through
+                "name": "brownout",
+                "point": "storage.write",
+                "action": "io_error",
+                "max_count": 1,
+            },
+        ],
+    })
+
+
 def shm_corruption(seed: int = 17) -> Scenario:
     """Tear one shm snapshot right after it is written (writing=True
     republish): the persist and restore paths must refuse the torn
@@ -236,6 +340,33 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "straggler": straggler,
     "preemption_notice": preemption_notice,
     "shm_corruption": shm_corruption,
+    "shm_corrupt_storage_fallback": shm_corrupt_storage_fallback,
+    "ckpt_brownout_during_preemption": ckpt_brownout_during_preemption,
+}
+
+
+# per-scenario harness knobs, keyed by the SCENARIO's name field, so
+# the CLI and the tests drive each scenario the way it needs without
+# repeating the recipe: the tier-fallback scenario needs a committed
+# disk step to fall back to; the preemption scenarios need the
+# monitor armed (a fast-failing metadata URL keeps the pre-notice
+# probes cheap) and a stretched loop so the wall-clock window lands
+# mid-run
+RUN_OPTIONS: Dict[str, Dict] = {
+    "shm-corrupt-storage-fallback": {"disk_every": 4},
+    "ckpt-brownout-during-preemption": {
+        "step_sleep": 1.0,
+        "extra_env": {
+            "DLROVER_PREEMPTION_MONITOR": "1",
+            "DLROVER_METADATA_SERVER": "http://127.0.0.1:9/preempted",
+        },
+    },
+    "preemption-notice": {
+        "extra_env": {
+            "DLROVER_PREEMPTION_MONITOR": "1",
+            "DLROVER_METADATA_SERVER": "http://127.0.0.1:9/preempted",
+        },
+    },
 }
 
 

@@ -93,6 +93,13 @@ class Checkpointer:
             self._orbax_dirty = True
         return ok
 
+    @property
+    def last_restore_phases(self):
+        """Stage breakdown of the last restore (``tier``, ``read_s``,
+        ``assemble_s``, ``h2d_s``, ``total_s``, ``workers``) — the
+        same numbers the ``checkpoint_restore`` event carries."""
+        return dict(self._engine.last_restore_phases)
+
     def load_checkpoint(
         self, target_state: Any = None, orbax_dir: str = "",
     ) -> Tuple[Optional[int], Any]:
@@ -100,7 +107,11 @@ class Checkpointer:
         same-topology).  With ``target_state`` (a pytree of sharded
         jax.Arrays): every leaf is re-assembled onto the target's
         shardings — shm, then storage, then the orbax tier at
-        ``orbax_dir`` (reference: fsdp_engine re-shard on load)."""
+        ``orbax_dir`` (reference: fsdp_engine re-shard on load).
+
+        Both paths run the staged restore pipeline (read → assemble →
+        h2d overlapped; ``DLROVER_RESTORE_WORKERS`` sizes the pool,
+        ``1`` = exact serial path)."""
         if target_state is not None:
             return self._engine.load_sharded(
                 target_state, orbax_dir=orbax_dir or self._orbax_dir

@@ -446,24 +446,53 @@ class AsyncCheckpointSaver:
 
 def read_last_checkpoint(
     checkpoint_dir: str, storage: Optional[CheckpointStorage] = None,
+    workers: Optional[int] = None, stats=None,
+    only_rank: Optional[int] = None,
 ):
     """Storage-side load: tracker file -> per-rank shard dict
     (reference: the load fallback in engine.py:325 when shm misses).
     Returns (step, {global_rank: (meta, raw_bytes)}) or (None, {}).
+
+    Shard blobs attach via ``storage.read_view`` — an O(1) lazy mmap
+    on the posix backend, so the bytes page in while the restore
+    pipeline's assembly stage consumes them — and the per-rank
+    meta/blob fetches run concurrently on the restore pool (remote
+    backends pay one round trip per rank instead of a serial chain).
+    ``workers=1`` (or ``DLROVER_RESTORE_WORKERS=1``) degrades to the
+    exact serial sequence.  ``only_rank`` narrows the fetch to one
+    rank's files — the replicated/single-shard restore must not pull
+    every rank's blob off a remote backend to use one of them (the
+    sharded re-assembly path genuinely needs them all and leaves it
+    None).
     """
+    import time as _time
+
+    from dlrover_tpu.checkpoint.restore import StagedRestore
+
+    t0 = _time.perf_counter()
     storage = storage or get_checkpoint_storage(path=checkpoint_dir)
     tracker = os.path.join(checkpoint_dir, CheckpointConstant.TRACKER_FILE)
     if not storage.exists(tracker):
         return None, {}
     step = int(str(storage.read(tracker, mode="r")).strip())
     step_dir = os.path.join(checkpoint_dir, step_dirname(step))
-    shards: Dict[int, tuple] = {}
-    for fname in storage.listdir(step_dir):
-        if fname.startswith("rank_") and fname.endswith(".ckpt"):
-            rank = int(fname[len("rank_"):-len(".ckpt")])
-            raw = storage.read(os.path.join(step_dir, fname))
-            meta = pickle.loads(
-                storage.read(os.path.join(step_dir, meta_file(rank)))
-            )
-            shards[rank] = (meta, raw)
+    names = [
+        fname for fname in storage.listdir(step_dir)
+        if fname.startswith("rank_") and fname.endswith(".ckpt")
+    ]
+    if only_rank is not None:
+        names = [f for f in names if f == shard_file(only_rank)]
+
+    def _one(fname: str):
+        rank = int(fname[len("rank_"):-len(".ckpt")])
+        raw = storage.read_view(os.path.join(step_dir, fname))
+        meta = pickle.loads(
+            storage.read(os.path.join(step_dir, meta_file(rank)))
+        )
+        return rank, (meta, raw)
+
+    with StagedRestore(workers) as staged:
+        shards: Dict[int, tuple] = dict(staged.map_ordered(_one, names))
+    if stats is not None:
+        stats.read_s += _time.perf_counter() - t0
     return step, shards

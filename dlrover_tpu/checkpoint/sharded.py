@@ -106,6 +106,48 @@ def assemble_shard(
     return out
 
 
+def assemble_target_pieces(
+    global_shape: Tuple[int, ...],
+    dtype,
+    sharding,
+    entries: Sequence[Tuple[IndexRanges, np.ndarray]],
+) -> Optional[List[Tuple[object, np.ndarray]]]:
+    """Host-side half of a target-sharded restore: the per-device
+    shard pieces as ``[(device, host_array)]``, or None when the
+    saved entries do not cover the target.  Pure numpy — safe on a
+    restore-pipeline worker thread; the returned pieces are private
+    arrays, so committing them to devices later can never alias the
+    source shm/mmap buffer."""
+    pieces: List[Tuple[object, np.ndarray]] = []
+    for device, index in sharding.addressable_devices_indices_map(
+        tuple(global_shape)
+    ).items():
+        ranges = index_ranges(index, global_shape)
+        piece = assemble_shard(ranges, dtype, entries)
+        if piece is None:
+            return None
+        pieces.append((device, piece))
+    return pieces
+
+
+def commit_target_pieces(
+    global_shape: Tuple[int, ...], sharding,
+    pieces: Sequence[Tuple[object, np.ndarray]],
+):
+    """Device-side half: ship the host pieces and build the global
+    jax.Array.  ``device_put`` transfers are issued back to back
+    (asynchronous on real hardware), so piece k+1's H2D overlaps
+    piece k's."""
+    import jax
+
+    device_arrays = [
+        jax.device_put(piece, device) for device, piece in pieces
+    ]
+    return jax.make_array_from_single_device_arrays(
+        tuple(global_shape), sharding, device_arrays
+    )
+
+
 def assemble_global_array(
     global_shape: Tuple[int, ...],
     dtype,
@@ -114,20 +156,12 @@ def assemble_global_array(
 ):
     """Assemble a global jax.Array for this process's devices from
     saved (ranges, data) entries; None if coverage is incomplete."""
-    import jax
-
-    device_arrays = []
-    for device, index in sharding.addressable_devices_indices_map(
-        tuple(global_shape)
-    ).items():
-        ranges = index_ranges(index, global_shape)
-        piece = assemble_shard(ranges, dtype, entries)
-        if piece is None:
-            return None
-        device_arrays.append(jax.device_put(piece, device))
-    return jax.make_array_from_single_device_arrays(
-        tuple(global_shape), sharding, device_arrays
+    pieces = assemble_target_pieces(
+        global_shape, dtype, sharding, entries
     )
+    if pieces is None:
+        return None
+    return commit_target_pieces(global_shape, sharding, pieces)
 
 
 def group_shard_entries(

@@ -360,11 +360,27 @@ class SharedMemoryHandler:
         return self._shm
 
     def load_flat(
-        self,
+        self, detach: bool = True, stats=None,
     ) -> Tuple[Optional[CheckpointConfig], Dict[str, Any], Dict[str, Any]]:
         """Read the shm snapshot as (config, flat {key: array or
         scalar}, {key: TensorMeta}) — shard entries keep their
-        ``@shardN`` keys for target-sharded reassembly."""
+        ``@shardN`` keys for target-sharded reassembly.
+
+        ``detach=True`` copies every leaf out of the segment through
+        the staged restore pipeline (chunked, GIL-released, parallel —
+        the serial per-leaf ``arr.copy()`` this replaces paid the
+        mapping's page faults single-threaded).  ``detach=False``
+        returns live views into shm: valid only until the next save
+        overwrites the segment, so callers must finish (or detach /
+        ``device_put``-copy) before returning control — the GSPMD
+        restore path feeds them straight into batched ``device_put``.
+        ``stats`` is a :class:`~.restore.RestoreStats` accumulator.
+        """
+        import time as _time
+
+        from dlrover_tpu.checkpoint.restore import detach_flat
+
+        t0 = _time.perf_counter()
         meta = self._meta.get(default_if_absent=True)
         if not meta:
             return None, {}, {}
@@ -377,32 +393,37 @@ class SharedMemoryHandler:
         )
         if shm is None:
             return None, {}, {}
-        flat: Dict[str, Any] = {}
-        for key, m in meta["tensors"].items():
-            arr = np.frombuffer(
-                shm.buf, dtype=np.dtype(m.dtype), count=int(
-                    np.prod(m.shape, dtype=np.int64)
-                ) if m.shape else 1, offset=m.offset,
-            ).reshape(m.shape)
-            flat[key] = arr.copy()  # detach from the buffer lifetime
+        views = _views_from(meta["tensors"], shm.buf)
         blob = bytes(
             shm.buf[
                 meta["scalar_offset"]:
                 meta["scalar_offset"] + meta["scalar_nbytes"]
             ]
         )
+        if stats is not None:
+            stats.read_s += _time.perf_counter() - t0
+            if not detach:
+                stats.bytes += sum(v.nbytes for v in views.values())
+        flat = detach_flat(views, stats=stats) if detach else views
         flat.update(pickle.loads(blob))
         return config, flat, meta["tensors"]
 
-    def load_state_dict(self) -> Tuple[Optional[CheckpointConfig], Any]:
-        """Zero-copy-read the shm snapshot back into a nested dict of
+    def load_state_dict(
+        self, stats=None,
+    ) -> Tuple[Optional[CheckpointConfig], Any]:
+        """Read the shm snapshot back into a nested dict of private
         numpy arrays (caller device_puts with its shardings).  Shard
         entries of global arrays are assembled to full host arrays
         when this process's shards cover them (always single-host)."""
-        config, flat, metas = self.load_flat()
+        import time as _time
+
+        config, flat, metas = self.load_flat(stats=stats)
         if config is None:
             return None, {}
+        t0 = _time.perf_counter()
         flat = _assemble_flat(flat, metas)
+        if stats is not None:
+            stats.assemble_s += _time.perf_counter() - t0
         return config, _unflatten_to_nested(flat)
 
     def read_raw(self) -> Tuple[Optional[CheckpointConfig], Any, Dict]:
@@ -435,17 +456,33 @@ class SharedMemoryHandler:
             self._shm = None
 
 
-def flat_from_raw(meta: Dict, raw: bytes) -> Tuple[Dict, Dict]:
-    """(flat {key: array/scalar}, {key: TensorMeta}) from raw shm
-    bytes (storage load path), shard keys preserved."""
-    flat: Dict[str, Any] = {}
-    for key, m in meta["tensors"].items():
-        arr = np.frombuffer(
-            raw, dtype=np.dtype(m.dtype),
+def _views_from(metas: Dict[str, TensorMeta], buf) -> Dict[str, np.ndarray]:
+    """{key: np.frombuffer view} over a shm segment or raw/mmap blob —
+    free to build; paging/copy cost is paid by whichever pipeline
+    stage consumes the view."""
+    views: Dict[str, np.ndarray] = {}
+    for key, m in metas.items():
+        views[key] = np.frombuffer(
+            buf, dtype=np.dtype(m.dtype),
             count=int(np.prod(m.shape, dtype=np.int64)) if m.shape else 1,
             offset=m.offset,
         ).reshape(m.shape)
-        flat[key] = arr.copy()
+    return views
+
+
+def flat_from_raw(
+    meta: Dict, raw, detach: bool = True, stats=None,
+) -> Tuple[Dict, Dict]:
+    """(flat {key: array/scalar}, {key: TensorMeta}) from raw shm
+    bytes — or an mmap view from ``storage.read_view`` — shard keys
+    preserved.  ``detach=False`` returns views into ``raw`` (the
+    caller keeps ``raw`` alive until it is done)."""
+    from dlrover_tpu.checkpoint.restore import detach_flat
+
+    views = _views_from(meta["tensors"], raw)
+    if stats is not None and not detach:
+        stats.bytes += sum(v.nbytes for v in views.values())
+    flat = detach_flat(views, stats=stats) if detach else views
     blob = raw[
         meta["scalar_offset"]:meta["scalar_offset"] + meta["scalar_nbytes"]
     ]
@@ -483,8 +520,14 @@ def _assemble_flat(flat: Dict[str, Any], metas: Dict[str, Any]):
     return plain
 
 
-def state_dict_from_raw(meta: Dict, raw: bytes):
-    """Rebuild the nested dict from raw shm bytes (storage load path)."""
-    flat, metas = flat_from_raw(meta, raw)
+def state_dict_from_raw(meta: Dict, raw, stats=None):
+    """Rebuild the nested dict from raw shm bytes (storage load path);
+    detach copies run through the staged restore pipeline."""
+    import time as _time
+
+    flat, metas = flat_from_raw(meta, raw, stats=stats)
+    t0 = _time.perf_counter()
     flat = _assemble_flat(flat, metas)
+    if stats is not None:
+        stats.assemble_s += _time.perf_counter() - t0
     return _unflatten_to_nested(flat)

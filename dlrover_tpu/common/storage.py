@@ -70,6 +70,13 @@ class CheckpointStorage:
     def read(self, path: str, mode: str = "rb"):
         raise NotImplementedError
 
+    def read_view(self, path: str):
+        """Bytes-like view of ``path`` for the restore pipeline.  The
+        base implementation is an eager :meth:`read`; backends with a
+        lazy option (posix mmap) override so page-in overlaps the
+        assembly stage instead of serializing in front of it."""
+        return self.read(path)
+
     def safe_move(self, src: str, dst: str):
         raise NotImplementedError
 
@@ -121,6 +128,24 @@ class PosixDiskStorage(CheckpointStorage):
             return None
         with open(path, mode) as f:
             return f.read()
+
+    def read_view(self, path: str):
+        """mmap the file read-only: attaching is O(1) and pages fault
+        in lazily, so the restore pipeline's chunked parallel copies
+        overlap disk read-ahead with assembly and H2D instead of
+        waiting for a full eager read first.  The mapping outlives the
+        fd (closed immediately) and is released when the last
+        ``frombuffer`` view drops."""
+        _chaos.fire("storage.read", path=path)
+        if not os.path.exists(path):
+            return None
+        import mmap
+
+        with open(path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            if size == 0:
+                return b""
+            return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
 
     def safe_move(self, src: str, dst: str):
         _chaos.fire("storage.move", path=dst)

@@ -15,6 +15,7 @@ from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.constants import (
     NodeEventType,
+    NodeExitReason,
     NodeStatus,
     NodeType,
 )
@@ -92,6 +93,23 @@ class JobManager:
         )
         self._fire(NodeEvent(event_type, node))
         return True
+
+    def handle_preemption_notice(self, node_id: int, node_type: str):
+        """ADVANCE preemption notice: the node is still alive and
+        stepping, so it must NOT transition to an end state here (the
+        real exit arrives later via the watcher or a failure report —
+        treating the notice as a death made the master abort a job
+        whose only worker was still training through the grace
+        period).  The base manager just records the pending reason;
+        the distributed manager additionally starts replacement
+        placement immediately."""
+        node = self.add_node(node_type, node_id)
+        node.exit_reason = NodeExitReason.PREEMPTED
+        logger.info(
+            "advance preemption notice for node %s (%s); node stays "
+            "%s until it actually exits", node_id, node_type,
+            node.status,
+        )
 
     def _fire(self, event: NodeEvent):
         for cb in self._event_callbacks:

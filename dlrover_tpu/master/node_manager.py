@@ -152,6 +152,43 @@ class DistributedJobManager(JobManager):
         ):
             self._handle_node_exit(node)
 
+    def handle_preemption_notice(self, node_id: int, node_type: str):
+        """ADVANCE notice from the agent's preemption monitor: start
+        replacement placement NOW (the whole point of the ~30 s
+        warning) but leave the node RUNNING — it is still alive and
+        stepping, and marking it an end state here made the master
+        conclude ``all_workers_exited`` and abort a job whose only
+        worker was happily training through the grace period.  The
+        relaunch marks the node released, so the REAL exit that
+        follows (watcher event or agent failure report) is treated as
+        already handled — no double replacement, no job abort."""
+        node = self.get_node(node_id)
+        if node is None or node.is_released:
+            return
+        if node.status in NodeStatus.end_states():
+            # the notice lost the race against the actual exit (the
+            # report side-thread retries with seconds of backoff): the
+            # exit handler already decided relaunch-vs-abort, and a
+            # FATAL_ERROR decline must not be overwritten into a
+            # relaunchable PREEMPTED here
+            return
+        node.exit_reason = NodeExitReason.PREEMPTED
+        # claim under the lock, scale OUTSIDE it — same pattern as
+        # _handle_node_exit: a stalled cloud API call must not
+        # serialize every concurrent death/notice behind this one
+        with self._relaunch_lock:
+            if not self._should_relaunch(node):
+                return
+            node.is_released = True
+        logger.info(
+            "preemption notice for node %s (%s): starting "
+            "replacement placement while it is still alive",
+            node_id, node_type,
+        )
+        # remove=False: the pod is alive and mid-grace-period — the
+        # cloud takes it, this master must not
+        self._relaunch_node(node, remove=False)
+
     def _handle_node_exit(self, node: Node):
         with self._relaunch_lock:
             already_handled = node.is_released
@@ -200,10 +237,15 @@ class DistributedJobManager(JobManager):
             if n.status in NodeStatus.end_states()
         )
 
-    def _relaunch_node(self, node: Node):
+    def _relaunch_node(self, node: Node, remove: bool = True):
         """Reference: _relaunch_node, dist_job_manager.py:605 — a new
         node id replaces the dead one at the same rank AND type (a
-        dead evaluator comes back as an evaluator)."""
+        dead evaluator comes back as an evaluator).  ``remove=False``
+        launches the replacement WITHOUT putting the old node in the
+        plan's remove set — the advance-preemption path, where the
+        old pod is still alive and the cloud (not this master) will
+        take it; deleting it here would cut off the grace window the
+        breakpoint save needs."""
         import dataclasses as _dc
 
         node.inc_relaunch_count()
@@ -228,7 +270,8 @@ class DistributedJobManager(JobManager):
             node.max_relaunch_count,
         )
         plan = ScalePlan(
-            launch_nodes=[replacement], remove_nodes=[node]
+            launch_nodes=[replacement],
+            remove_nodes=[node] if remove else [],
         )
         self._scaler.scale(plan)
 

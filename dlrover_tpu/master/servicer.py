@@ -198,6 +198,17 @@ class MasterServicer(RequestHandler):
             return True
 
         if isinstance(message, msg.NodeEventReport):
+            if message.event_type == "preemption_notice":
+                # ADVANCE notice: the node is still alive and
+                # stepping — plan the replacement now, transition the
+                # node only when it actually exits (watcher event or
+                # failure report).  Routing this through the status
+                # path marked a live node FAILED and aborted the job
+                # mid-grace-period.
+                self._job_manager.handle_preemption_notice(
+                    message.node_id, message.node_type
+                )
+                return True
             # membership/speed/shard-recycling side effects happen in
             # the registered event callbacks (event_callback.py), not
             # inline — one path for agent-reported and watcher-observed

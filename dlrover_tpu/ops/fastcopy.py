@@ -59,3 +59,38 @@ def copy_into(dst: np.ndarray, src: np.ndarray) -> None:
     lib.dlrover_fastcopy(
         dst.ctypes.data, src.ctypes.data, dst.nbytes
     )
+
+
+def copy_into_chunked(
+    dst: np.ndarray,
+    src: np.ndarray,
+    submit=None,
+    chunk_bytes: int = 64 * 2**20,
+):
+    """``dst[...] = src`` split into ~``chunk_bytes`` contiguous
+    pieces.  Each piece is dispatched through ``submit(fn, *args)``
+    (a thread-pool submit — the GIL-released :func:`copy_into` makes
+    the pieces genuinely concurrent, page faults included) or run
+    inline when ``submit`` is None; returns whatever ``submit``
+    returned per piece so the caller can drain.  The restore pipeline
+    uses this to parallelize the detach of one large leaf, where a
+    single serial memcpy against a cold shm mapping is fault-bound.
+    """
+    if not (
+        dst.flags["C_CONTIGUOUS"] and src.flags["C_CONTIGUOUS"]
+    ):
+        # reshape(-1) of a non-contiguous array is a COPY — chunk
+        # writes would land in a temporary and dst stay untouched
+        np.copyto(dst, src)
+        return []
+    d1, s1 = dst.reshape(-1), src.reshape(-1)
+    if d1.size == 0:
+        return []
+    step = max(1, chunk_bytes // max(1, d1.dtype.itemsize))
+    out = []
+    for lo in range(0, d1.size, step):
+        if submit is None:
+            copy_into(d1[lo:lo + step], s1[lo:lo + step])
+        else:
+            out.append(submit(copy_into, d1[lo:lo + step], s1[lo:lo + step]))
+    return out

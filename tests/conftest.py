@@ -42,14 +42,20 @@ def pytest_configure(config):
 
 
 def pytest_collection_modifyitems(config, items):
-    """Run the stdlib-only telemetry + chaos unit tests before the
-    jit/e2e heavyweights.  On a slow box a wall-clock-bounded CI
-    window can truncate the (alphabetical) tail of the suite; these
-    tests cost milliseconds-to-seconds, must never be the ones dropped
-    (every other subsystem records through the registry/hooks they
-    verify), and are side-effect-free first (fresh registry/exporter/
-    injector instances, cleaned up by their own fixtures)."""
-    early_files = ("test_telemetry.py", "test_chaos.py")
+    """Run the stdlib-only telemetry + chaos unit tests AND the
+    restore-pipeline equivalence tests before the jit/e2e
+    heavyweights.  On a slow box a wall-clock-bounded CI window can
+    truncate the (alphabetical) tail of the suite; these tests cost
+    milliseconds-to-seconds, must never be the ones dropped (every
+    other subsystem records through the registry/hooks they verify;
+    the restore tests are the bit-identity net under the checkpoint
+    recovery path), and are side-effect-free first (fresh registry/
+    exporter/injector/engine instances, cleaned up by their own
+    fixtures)."""
+    early_files = (
+        "test_telemetry.py", "test_chaos.py",
+        "test_restore_pipeline.py",
+    )
     early = [
         it for it in items
         if it.nodeid.split("::", 1)[0].endswith(early_files)

@@ -683,3 +683,68 @@ def test_cli_list_and_show(capsys):
     ) == 0
     spec = json.loads(capsys.readouterr().out)
     assert spec["name"] == "rpc-partition" and spec["seed"] == 5
+
+
+def test_restored_from_tier_checker():
+    """The tier-fallback invariant keys on the checkpoint_restore
+    event's tier field: the FIRST post-fault restore decides."""
+    from dlrover_tpu.chaos.harness import RestoredFromTier
+
+    fault = {"type": "chaos_inject", "ts": 2.0, "seq": 0,
+             "point": "ckpt.shm_save", "rule": "torn",
+             "action": "corrupt_shm", "step": 6}
+
+    def restore(ts, tier):
+        return {"type": "checkpoint_restore", "ts": ts,
+                "tier": tier, "step": 4}
+
+    good = [fault, restore(3.0, "storage")]
+    assert RestoredFromTier("storage").check(good, None)
+    # restored from shm despite the corruption -> the refusal failed
+    bad = [fault, restore(3.0, "shm")]
+    res = RestoredFromTier("storage").check(bad, None)
+    assert not res and "shm" in res.detail
+    # a PRE-fault restore (initial boot) must not satisfy the check
+    pre_only = [restore(1.0, "storage"), fault]
+    assert not RestoredFromTier("storage").check(pre_only, None)
+    assert not RestoredFromTier("storage").check([fault], None)
+
+
+def test_new_scenarios_build_and_select_invariants(tmp_path):
+    """The tier-fallback scenario gets the recovery trail + tier
+    assertion (step loss bounded by the DISK interval); the
+    brownout-during-preemption scenario is judged ride-it-out."""
+    from dlrover_tpu.chaos import scenarios
+    from dlrover_tpu.chaos.harness import (
+        BoundedStepLoss,
+        RestoredFromTier,
+        invariants_for_scenario,
+    )
+
+    s = scenarios.build("shm_corrupt_storage_fallback", seed=1)
+    assert [r.action for r in s.rules] == ["corrupt_shm", "kill"]
+    assert all(r.only_first_incarnation for r in s.rules)
+    inv = invariants_for_scenario(s.name, 8, 2, str(tmp_path))
+    tiers = [i for i in inv if isinstance(i, RestoredFromTier)]
+    assert tiers and tiers[0].tier == "storage"
+    loss = [i for i in inv if isinstance(i, BoundedStepLoss)]
+    # bounded by the disk interval, not the (torn) shm interval
+    assert loss and loss[0].ckpt_interval == 4
+
+    b = scenarios.build("ckpt_brownout_during_preemption", seed=2)
+    assert {r.action for r in b.rules} == {"preempt", "io_error"}
+    inv = invariants_for_scenario(b.name, 8, 2, str(tmp_path))
+    assert [i.name for i in inv] == [
+        "training_completed", "no_orphan_processes",
+    ]
+    # the brownout is bounded: one injected failure, then the final
+    # commit must go through
+    io_rule = next(r for r in b.rules if r.action == "io_error")
+    assert io_rule.max_count == 1
+    # the harness knows how to drive them (disk tier / monitor arming)
+    assert scenarios.RUN_OPTIONS["shm-corrupt-storage-fallback"][
+        "disk_every"
+    ] == 4
+    assert "DLROVER_PREEMPTION_MONITOR" in scenarios.RUN_OPTIONS[
+        "ckpt-brownout-during-preemption"
+    ]["extra_env"]

@@ -518,3 +518,38 @@ def test_two_watch_streams_same_selector_both_see_events(k8s):
         assert "replayed" not in kinds, kinds
     # departed streams are unregistered: no unbounded accumulation
     assert api._streams == []
+
+
+def test_advance_notice_launches_replacement_without_killing_pod(k8s):
+    """handle_preemption_notice (servicer route for event_type
+    preemption_notice) must start replacement placement while the
+    node is STILL ALIVE: the live pod is not deleted (the cloud takes
+    it — removing it here would cut off the grace window the
+    breakpoint save needs), the node stays RUNNING, and the real
+    death later is already-handled (no second relaunch)."""
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-0", "Running")
+        assert _wait_until(
+            lambda: mgr.get_node(0) is not None
+            and mgr.get_node(0).status == NodeStatus.RUNNING
+        )
+        mgr.handle_preemption_notice(0, NodeType.WORKER)
+        # replacement launched...
+        assert _wait_until(lambda: "tj-worker-2" in api.pods)
+        assert mgr.get_node(2).rank_index == 0
+        # ...but the live pod survives and the node is still running
+        assert "tj-worker-0" in api.pods
+        assert mgr.get_node(0).status == NodeStatus.RUNNING
+        assert mgr.get_node(0).is_released  # claim recorded
+        # the actual preemption lands later: no second relaunch, no
+        # job abort
+        api.set_pod_phase("tj-worker-0", "Failed", reason="Preempted")
+        time.sleep(0.5)
+        assert "tj-worker-3" not in api.pods
+        assert mgr.job_exit_reason == ""
+    finally:
+        mgr.stop()
