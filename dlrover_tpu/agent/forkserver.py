@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common.log import default_logger as logger
 
 DEFAULT_PRELOAD = "jax,jax.numpy,flax,optax,numpy"
@@ -85,6 +86,10 @@ def _template_main(req_fd: int, ev_fd: int):
         mod = mod.strip()
         if not mod:
             continue
+        # chaos hook: a kill here dies mid-import (half-warmed
+        # template) — the agent must detect the death and fall back
+        # to cold spawns instead of waiting on a corpse
+        _chaos.fire("forkserver.template_import", module=mod)
         try:
             __import__(mod)
         except Exception:  # noqa: BLE001 - preload is best-effort
@@ -131,6 +136,10 @@ def _template_main(req_fd: int, ev_fd: int):
             continue
         if spec.get("event") == "shutdown":
             break
+        # chaos hook: a kill here dies mid-spawn (request consumed,
+        # no child forked, no reply coming) — the hardest template
+        # loss for the agent to get right
+        _chaos.fire("forkserver.spawn", req=spec.get("req", -1))
         pid = os.fork()
         if pid == 0:
             # ---- child: become the worker
@@ -308,7 +317,13 @@ class WorkerForkServer:
         self._generation += 1
         req_r, req_w = os.pipe()
         ev_r, ev_w = os.pipe()
-        env = dict(os.environ, DLROVER_PRELOAD=self._preload)
+        env = dict(
+            os.environ,
+            DLROVER_PRELOAD=self._preload,
+            # which template incarnation this is — chaos rules use it
+            # (env_equals) to fault one generation and spare rebuilds
+            DLROVER_FORKSERVER_GENERATION=str(self._generation),
+        )
         self._proc = subprocess.Popen(
             [
                 sys.executable, "-m", "dlrover_tpu.agent.forkserver",
@@ -379,6 +394,28 @@ class WorkerForkServer:
             if pid is not None:
                 self._register_pid(pid)
                 return ForkedWorkerHandle(pid, self)
+            if self._proc is None or self._proc.poll() is not None:
+                # the template died under us (kill mid-import, kill
+                # mid-spawn): no reply is ever coming — fail NOW so
+                # the caller's cold-spawn fallback runs in
+                # milliseconds instead of after the full timeout
+                # (the chaos warm-restart scenarios pin this path).
+                # Same abandoned-req guard as the timeout path below:
+                # the template may have forked the worker and written
+                # the 'spawned' event just before dying — if the
+                # reader delivers it after we raise, that pid must be
+                # reaped, not leaked next to the cold-spawned
+                # duplicate
+                with self._lock:
+                    late = self._spawn_results.pop(req_id, None)
+                    if late is None:
+                        self._abandoned.add(req_id)
+                if late is not None:
+                    self._register_pid(late)
+                    return ForkedWorkerHandle(late, self)
+                raise RuntimeError(
+                    "fork template died before answering the spawn"
+                )
             time.sleep(0.01)
         with self._lock:
             # the template may still complete this spawn after the

@@ -15,9 +15,18 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common import messages as msg
-from dlrover_tpu.common.comm import MessageClient
+from dlrover_tpu.common.comm import (
+    RPC_RESYNC_TIMEOUT_ENV,
+    MessageClient,
+)
 from dlrover_tpu.common.constants import NodeEnv, NodeType, TaskType
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry.events import emit_event
+
+# how long an agent/trainer parks waiting for a crashed master to be
+# respawned before giving up (seconds); the journal-backed respawn
+# takes ~1-2 s locally, minutes on a cluster scheduler
+DEFAULT_RESYNC_TIMEOUT = 120.0
 
 
 def retry_request(func):
@@ -54,7 +63,62 @@ class MasterClient:
         self._addr = master_addr
         self._node_id = node_id
         self._node_type = node_type
-        self._client = MessageClient(master_addr, node_id, node_type)
+        try:
+            resync_timeout = float(
+                os.environ.get(
+                    RPC_RESYNC_TIMEOUT_ENV, DEFAULT_RESYNC_TIMEOUT
+                )
+            )
+        except ValueError:
+            resync_timeout = DEFAULT_RESYNC_TIMEOUT
+        self._client = MessageClient(
+            master_addr, node_id, node_type,
+            resync_timeout=resync_timeout,
+        )
+        # durable progress marks replayed to a recovered master so it
+        # rebuilds this node's live state without restarting trainers
+        self._last_reported_step = 0
+        self._last_acked_dataset = ""
+        self._last_acked_task = -1
+        self._master_incarnation = ""
+        self._client.set_session_resync(self._session_resync)
+
+    def _session_resync(self):
+        """Handshake replayed after the master comes back from a
+        crash (called by the transport's park loop, re-entrancy
+        guarded there)."""
+        resp: msg.SessionResyncResponse = self._client.get(
+            msg.SessionResyncRequest(
+                node_id=self._node_id,
+                node_rank=env_utils.get_node_rank(),
+                node_type=self._node_type,
+                local_world_size=env_utils.get_local_world_size(),
+                restart_count=env_utils.get_restart_count(),
+                last_step=self._last_reported_step,
+                last_acked_dataset=self._last_acked_dataset,
+                last_acked_task=self._last_acked_task,
+            )
+        )
+        recovered = bool(
+            self._master_incarnation
+            and resp.incarnation != self._master_incarnation
+        )
+        self._master_incarnation = resp.incarnation
+        emit_event(
+            "master_resync",
+            node_id=self._node_id,
+            incarnation=resp.incarnation,
+            recoveries=resp.recoveries,
+            rdzv_round=resp.rdzv_round,
+            master_changed=recovered,
+            last_step=self._last_reported_step,
+        )
+        logger.warning(
+            "session resync with master %s complete (incarnation %s, "
+            "recoveries %s, rdzv round %s)",
+            self._addr, resp.incarnation, resp.recoveries,
+            resp.rdzv_round,
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -211,7 +275,7 @@ class MasterClient:
         self, dataset_name: str, task_id: int, success: bool = True,
         error: str = "",
     ) -> bool:
-        return self._client.report(
+        ok = self._client.report(
             msg.ReportTaskResultRequest(
                 task_id=task_id,
                 dataset_name=dataset_name,
@@ -220,6 +284,10 @@ class MasterClient:
                 error=error,
             )
         )
+        if ok and success:
+            self._last_acked_dataset = dataset_name
+            self._last_acked_task = task_id
+        return ok
 
     @retry_request
     def get_dataset_checkpoint(self, dataset_name: str) -> str:
@@ -242,13 +310,17 @@ class MasterClient:
 
     @retry_request
     def report_global_step(self, global_step: int, timestamp: float = 0.0):
-        return self._client.report(
+        ok = self._client.report(
             msg.GlobalStepRecord(
                 node_id=self._node_id,
                 global_step=global_step,
                 timestamp=timestamp or time.time(),
             )
         )
+        self._last_reported_step = max(
+            self._last_reported_step, int(global_step)
+        )
+        return ok
 
     @retry_request
     def report_resource_stats(

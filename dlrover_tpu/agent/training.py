@@ -236,13 +236,30 @@ class ElasticTrainingAgent:
             self._forkserver._ensure_template()
         self._monitors = []
         if start_monitors:
+            # report cadence: 15 s suits production; the chaos/bench
+            # harnesses shorten it so the master's speed/goodput
+            # accounting has a real gap distribution on minute-scale
+            # mini-jobs
+            try:
+                report_interval = float(
+                    os.environ.get(
+                        "DLROVER_MONITOR_REPORT_INTERVAL", "15"
+                    )
+                )
+            except ValueError:
+                report_interval = 15.0
             self._monitors = [
-                ResourceMonitor(client=self._client),
+                ResourceMonitor(
+                    interval=report_interval, client=self._client
+                ),
                 TrainingMonitor(
                     TrainingMonitor.default_metrics_path(),
+                    interval=report_interval,
                     client=self._client,
                 ),
-                HeartbeatReporter(client=self._client),
+                HeartbeatReporter(
+                    interval=report_interval, client=self._client
+                ),
             ]
             from dlrover_tpu.agent.preemption import (
                 PreemptionMonitor,
@@ -351,16 +368,23 @@ class ElasticTrainingAgent:
                         forked_argv, env, nice_boost=boost
                     )
                 except RuntimeError as e:
-                    # watchdog: a wedged template must not turn one
-                    # kill into an unbounded recovery — fall back to
-                    # cold spawns for the REST OF THIS ROUND (a
-                    # rebuilt template would likely wedge the same
+                    # watchdog: a wedged or dead template must not
+                    # turn one kill into an unbounded recovery — fall
+                    # back to cold spawns for the REST OF THIS ROUND
+                    # (a rebuilt template would likely wedge the same
                     # way and burn another full timeout per rank);
                     # the next round's spawn rebuilds the template
                     logger.warning(
-                        "warm fork timed out (%s); cold-spawning "
+                        "warm fork failed (%s); cold-spawning "
                         "rank %d and the remaining ranks this "
                         "round", e, local_rank,
+                    )
+                    emit_event(
+                        "warm_fork_fallback",
+                        node_rank=self._node_rank,
+                        local_rank=local_rank,
+                        restart_count=self._restart_count,
+                        reason=str(e),
                     )
                     self._forkserver.close()
                     forked_argv = None

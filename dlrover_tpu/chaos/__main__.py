@@ -43,9 +43,19 @@ def parse_args(argv: Optional[List[str]] = None):
         "--workdir", type=str, default="",
         help="run directory (default: fresh temp dir)",
     )
-    parser.add_argument("--steps", type=int, default=10)
-    parser.add_argument("--ckpt-every", type=int, default=2)
+    parser.add_argument(
+        "--steps", type=int, default=None,
+        help="step budget (default: the scenario's RUN_OPTIONS "
+        "entry, else 10)",
+    )
+    parser.add_argument("--ckpt-every", type=int, default=None)
     parser.add_argument("--max-restarts", type=int, default=2)
+    parser.add_argument(
+        "--nnodes", type=int, default=1,
+        help=">1 runs the multi-agent harness (one journal-backed "
+        "master + N real tpurun agent processes) — what the "
+        "node-subset partition scenarios need",
+    )
     parser.add_argument(
         "--warm-restart", action="store_true",
         help="fork restarted workers from the warm template",
@@ -83,14 +93,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"running scenario {scenario.name!r} (seed {scenario.seed}) "
         f"in {workdir}"
     )
-    report = harness.run_scenario(
-        scenario,
-        workdir=workdir,
-        total_steps=args.steps,
-        ckpt_every=args.ckpt_every,
-        max_restarts=args.max_restarts,
-        warm_restart=args.warm_restart,
-    )
+    nnodes = args.nnodes
+    if nnodes <= 1 and scenario.name == "multinode-rpc-partition":
+        # the subset-partition scenario is meaningless single-node
+        nnodes = 2
+    if nnodes > 1:
+        report = harness.run_scenario_multinode(
+            scenario,
+            workdir=workdir,
+            nnodes=nnodes,
+            total_steps=args.steps,
+            ckpt_every=args.ckpt_every,
+            max_restarts=args.max_restarts,
+            warm_restart=args.warm_restart,
+            faulted_rank=(
+                1 if scenario.name == "multinode-rpc-partition"
+                else None
+            ),
+        )
+    else:
+        report = harness.run_scenario(
+            scenario,
+            workdir=workdir,
+            total_steps=args.steps,
+            ckpt_every=args.ckpt_every,
+            max_restarts=args.max_restarts,
+            warm_restart=args.warm_restart,
+        )
     print(report.summary())
     return 0 if report.ok else 1
 

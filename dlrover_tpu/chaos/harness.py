@@ -27,6 +27,8 @@ Invariants shipped here:
 
 import json
 import os
+import subprocess
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -37,6 +39,7 @@ from dlrover_tpu.chaos.scenarios import (
     CKPT_EVERY_ENV,
     DISK_EVERY_ENV,
     RUN_OPTIONS,
+    SHARD_DATASET_ENV,
     STEP_SLEEP_ENV,
     TOTAL_STEPS_ENV,
 )
@@ -314,6 +317,294 @@ class RestoredFromTier(Invariant):
         )
 
 
+class EventRecorded(Invariant):
+    """At least ``min_count`` events of ``event_type`` exist (e.g. a
+    ``warm_fork_fallback`` proving the cold-spawn path ran)."""
+
+    def __init__(self, event_type: str, min_count: int = 1):
+        self.event_type = event_type
+        self.min_count = min_count
+        self.name = f"event_recorded[{event_type}]"
+
+    def check(self, events, run):
+        hits = [e for e in events if e.get("type") == self.event_type]
+        if len(hits) < self.min_count:
+            return InvariantResult(
+                self.name, False,
+                f"{len(hits)} {self.event_type!r} event(s) < "
+                f"required {self.min_count}",
+            )
+        return InvariantResult(
+            self.name, True, f"{len(hits)} event(s)"
+        )
+
+
+class MasterRecovered(Invariant):
+    """A respawned master replayed the journal after the fault
+    (``master_recovered``) AND at least one client replayed the
+    session-resync handshake against it (``master_resync`` or
+    ``agent_resync``)."""
+
+    name = "master_recovered"
+
+    def check(self, events, run):
+        fault_ts = _first_fault_ts(events)
+        if fault_ts is None:
+            return InvariantResult(
+                self.name, False, "no chaos_inject event recorded"
+            )
+        recovered = [
+            e for e in events
+            if e.get("type") == "master_recovered"
+            and e["ts"] >= fault_ts
+        ]
+        if not recovered:
+            return InvariantResult(
+                self.name, False,
+                "no master_recovered event after the fault (journal "
+                "replay never ran)",
+            )
+        resyncs = [
+            e for e in events
+            if e.get("type") in ("master_resync", "agent_resync")
+            and e["ts"] >= fault_ts
+        ]
+        if not resyncs:
+            return InvariantResult(
+                self.name, False,
+                "master recovered but no client session-resync "
+                "handshake followed",
+            )
+        rec = recovered[0]
+        return InvariantResult(
+            self.name, True,
+            f"recovery #{rec.get('recoveries')} replayed "
+            f"{rec.get('entries')} entries (re-queued "
+            f"{rec.get('requeued')} lease(s)); "
+            f"{len(resyncs)} client resync(s)",
+        )
+
+
+class HealthyWorkersNotRestarted(Invariant):
+    """A master crash must NOT cascade into worker restarts: healthy
+    trainers ride out the outage on the parked RPC path."""
+
+    name = "healthy_workers_not_restarted"
+
+    def check(self, events, run):
+        restarts = [
+            e for e in events if e.get("type") == "worker_restart"
+        ]
+        if restarts:
+            return InvariantResult(
+                self.name, False,
+                f"{len(restarts)} worker restart(s): a master crash "
+                "cascaded into the data plane",
+            )
+        return InvariantResult(self.name, True, "no worker restarts")
+
+
+class NoDuplicateShards(Invariant):
+    """Dataset-shard exactly-once accounting across the fault, from
+    ``shard_ack`` events alone: every sample index acked exactly once
+    (none lost, none completed twice)."""
+
+    name = "no_duplicate_shards"
+
+    def __init__(self, dataset_size: int, dataset: str = "chaos-ds"):
+        self.dataset_size = dataset_size
+        self.dataset = dataset
+
+    def check(self, events, run):
+        acks = [
+            e for e in events
+            if e.get("type") == "shard_ack"
+            and e.get("dataset") == self.dataset
+            and e.get("success")
+        ]
+        if not acks:
+            return InvariantResult(
+                self.name, False, "no successful shard_ack events"
+            )
+        ranges = [(e.get("start"), e.get("end")) for e in acks]
+        dupes = {r for r in ranges if ranges.count(r) > 1}
+        if dupes:
+            return InvariantResult(
+                self.name, False,
+                f"shard range(s) acked more than once: "
+                f"{sorted(dupes)}",
+            )
+        covered = set()
+        for start, end in ranges:
+            covered.update(range(int(start), int(end)))
+        missing = set(range(self.dataset_size)) - covered
+        if missing:
+            return InvariantResult(
+                self.name, False,
+                f"{len(missing)} sample(s) never acked (lost "
+                f"shards): {sorted(missing)[:10]}",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{len(acks)} shard(s) acked exactly once, full "
+            f"coverage of {self.dataset_size} samples",
+        )
+
+
+class FinalStepCommitted(Invariant):
+    """The job's last reached step ended up durably committed (the
+    shard-driven loops derive their budget from the dataset, so the
+    bound is 'whatever the trainer actually reached')."""
+
+    name = "final_step_committed"
+
+    def check(self, events, run):
+        steps = [
+            e["step"] for e in events if e.get("type") == "train_step"
+        ]
+        commits = [
+            e.get("step") for e in events
+            if e.get("type") == "checkpoint_commit"
+        ]
+        if not steps:
+            return InvariantResult(
+                self.name, False, "no train_step events"
+            )
+        final = max(steps)
+        if final not in commits:
+            return InvariantResult(
+                self.name, False,
+                f"final step {final} never committed "
+                f"(commits: {sorted(set(commits))})",
+            )
+        return InvariantResult(
+            self.name, True, f"final step {final} committed"
+        )
+
+
+class GoodputAtLeast(Invariant):
+    """The master's own goodput accounting (SpeedMonitor ->
+    ``dlrover_goodput_ratio``, stamped on the ``master_exit`` event)
+    stayed at or above the bound through the scheduled churn."""
+
+    name = "goodput_at_least"
+
+    def __init__(self, threshold: float = 0.90):
+        self.threshold = threshold
+
+    def check(self, events, run):
+        exits = [
+            e for e in events if e.get("type") == "master_exit"
+        ]
+        if not exits:
+            return InvariantResult(
+                self.name, False,
+                "no master_exit event (master was killed, not "
+                "terminated?)",
+            )
+        goodput = exits[-1].get("goodput")
+        if goodput is None:
+            return InvariantResult(
+                self.name, False, "master_exit carries no goodput"
+            )
+        if float(goodput) < self.threshold:
+            return InvariantResult(
+                self.name, False,
+                f"goodput {float(goodput):.3f} < bound "
+                f"{self.threshold}",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"goodput {float(goodput):.3f} >= {self.threshold}",
+        )
+
+
+class NodeCompletedSteps(Invariant):
+    """Per-node progress in a multi-agent run: node ``rank`` stepped
+    through at least ``total_steps`` (train_step events carry
+    node_rank)."""
+
+    def __init__(self, rank: int, total_steps: int):
+        self.rank = rank
+        self.total_steps = total_steps
+        self.name = f"node{rank}_completed"
+
+    def check(self, events, run):
+        steps = [
+            e["step"] for e in events
+            if e.get("type") == "train_step"
+            and e.get("node_rank") == self.rank
+        ]
+        top = max(steps) if steps else None
+        if top is None or top < self.total_steps:
+            return InvariantResult(
+                self.name, False,
+                f"node {self.rank} reached step {top} < budget "
+                f"{self.total_steps}",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"node {self.rank} reached step {top}",
+        )
+
+
+class NoRestartForNode(Invariant):
+    """An un-partitioned node must never be restarted by someone
+    else's fault."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.name = f"node{rank}_not_restarted"
+
+    def check(self, events, run):
+        restarts = [
+            e for e in events
+            if e.get("type") == "worker_restart"
+            and e.get("node_rank") == self.rank
+        ]
+        if restarts:
+            return InvariantResult(
+                self.name, False,
+                f"node {self.rank} restarted {len(restarts)} "
+                "time(s) though it was never faulted",
+            )
+        return InvariantResult(
+            self.name, True, f"node {self.rank} never restarted"
+        )
+
+
+class InjectionsOnlyOnNode(Invariant):
+    """The fault stayed confined to its target: every injection's
+    node_rank equals ``rank`` (subset-partition scenarios)."""
+
+    def __init__(self, rank: int, action: str = ""):
+        self.rank = rank
+        self.action = action
+        self.name = f"injections_only_on_node{rank}"
+
+    def check(self, events, run):
+        inj = _injections(events)
+        if self.action:
+            inj = [e for e in inj if e.get("action") == self.action]
+        if not inj:
+            return InvariantResult(
+                self.name, False, "no matching injections recorded"
+            )
+        strays = [
+            e for e in inj if e.get("node_rank") != self.rank
+        ]
+        if strays:
+            return InvariantResult(
+                self.name, False,
+                f"{len(strays)} injection(s) fired on other nodes: "
+                f"{[e.get('node_rank') for e in strays]}",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{len(inj)} injection(s), all on node {self.rank}",
+        )
+
+
 class NoOrphanProcesses(Invariant):
     """No process whose cmdline or environment references the job's
     workdir survives the run — catches leaked trainers, forkserver
@@ -501,6 +792,29 @@ def invariants_for_scenario(
     name: str, total_steps: int, ckpt_every: int, workdir: str,
     disk_every: Optional[int] = None,
 ) -> List[Invariant]:
+    if name == "master-kill-restart-midround":
+        # the control-plane recovery trail: journal replay, client
+        # resyncs, exactly-once sharding, NO data-plane restarts
+        return [
+            MasterRecovered(),
+            HealthyWorkersNotRestarted(),
+            NoDuplicateShards(dataset_size=total_steps),
+            FinalStepCommitted(),
+            NoOrphanProcesses(marker=workdir),
+        ]
+    if name in ("warm-template-import-kill",
+                "warm-template-midspawn-kill"):
+        return [
+            EventRecorded("warm_fork_fallback"),
+            TrainingCompleted(total_steps=total_steps),
+            NoOrphanProcesses(marker=workdir),
+        ]
+    if name == "goodput-under-scheduled-churn":
+        return [
+            TrainingCompleted(total_steps=total_steps),
+            GoodputAtLeast(0.90),
+            NoOrphanProcesses(marker=workdir),
+        ]
     if name == "shm-corrupt-storage-fallback":
         # full recovery trail PLUS the tier assertion; step loss is
         # bounded by the DISK interval (the shm interval's snapshot
@@ -528,8 +842,8 @@ def invariants_for_scenario(
 def run_scenario(
     scenario,
     workdir: str,
-    total_steps: int = 10,
-    ckpt_every: int = 2,
+    total_steps: Optional[int] = None,
+    ckpt_every: Optional[int] = None,
     max_restarts: int = 2,
     monitor_interval: float = 0.3,
     warm_restart: bool = False,
@@ -544,16 +858,22 @@ def run_scenario(
     full restart trail, ride-it-out scenarios completion+no-orphans);
     pass ``invariants=[]`` to skip checking entirely.
 
-    ``disk_every`` (durable mid-run saves), ``step_sleep`` (stretch
-    the toy loop for wall-clock windows) and ``extra_env`` default to
-    the scenario's entry in :data:`scenarios.RUN_OPTIONS`, so named
+    ``total_steps``/``ckpt_every``/``disk_every`` (durable mid-run
+    saves), ``step_sleep`` (stretch the toy loop for wall-clock
+    windows), ``warm_restart`` and ``extra_env`` default to the
+    scenario's entry in :data:`scenarios.RUN_OPTIONS`, so named
     scenarios run correctly from the CLI and tests alike."""
     scenario = load_scenario(scenario)
     opts = RUN_OPTIONS.get(scenario.name, {})
+    if total_steps is None:
+        total_steps = int(opts.get("total_steps", 10))
+    if ckpt_every is None:
+        ckpt_every = int(opts.get("ckpt_every", 2))
     if disk_every is None:
         disk_every = int(opts.get("disk_every", 0))
     if step_sleep is None:
         step_sleep = float(opts.get("step_sleep", 0.0))
+    warm_restart = warm_restart or bool(opts.get("warm_restart"))
     os.makedirs(workdir, exist_ok=True)
     spec_path = os.path.join(workdir, "chaos_scenario.json")
     with open(spec_path, "w") as f:
@@ -580,6 +900,9 @@ def run_scenario(
         env[DISK_EVERY_ENV] = str(disk_every)
     if step_sleep:
         env[STEP_SLEEP_ENV] = str(step_sleep)
+    if opts.get("shard_dataset"):
+        # shard-driven loop: one sample per shard, one shard per step
+        env[SHARD_DATASET_ENV] = str(total_steps)
     env.update(opts.get("extra_env", {}))
     if extra_env:
         env.update(extra_env)
@@ -620,6 +943,207 @@ def run_scenario(
         else invariants_for_scenario(
             scenario.name, total_steps, ckpt_every, workdir,
             disk_every=disk_every,
+        )
+    )
+    for inv in checks:
+        try:
+            report.invariants.append(inv.check(events, report))
+        except Exception as e:  # noqa: BLE001 - a checker bug is a FAIL
+            logger.exception("invariant %s crashed", inv.name)
+            report.invariants.append(
+                InvariantResult(inv.name, False, f"checker crashed: {e}")
+            )
+    return report
+
+
+def default_multinode_invariants(
+    nnodes: int, total_steps: int, workdir: str,
+    faulted_rank: Optional[int] = None,
+) -> List[Invariant]:
+    """Per-node completion for every rank; when one rank carries the
+    fault, additionally pin the blast radius: injections confined to
+    it and no restart of the healthy ranks."""
+    checks: List[Invariant] = [
+        NodeCompletedSteps(rank, total_steps)
+        for rank in range(nnodes)
+    ]
+    if faulted_rank is not None:
+        checks.append(InjectionsOnlyOnNode(faulted_rank))
+        checks.extend(
+            NoRestartForNode(rank)
+            for rank in range(nnodes) if rank != faulted_rank
+        )
+    checks.append(NoOrphanProcesses(marker=workdir))
+    return checks
+
+
+def run_scenario_multinode(
+    scenario,
+    workdir: str,
+    nnodes: int = 2,
+    total_steps: Optional[int] = None,
+    ckpt_every: Optional[int] = None,
+    max_restarts: int = 2,
+    monitor_interval: float = 0.3,
+    warm_restart: bool = False,
+    invariants: Optional[List[Invariant]] = None,
+    faulted_rank: Optional[int] = None,
+    timeout: float = 240.0,
+) -> ChaosRunReport:
+    """Drive ``nnodes`` REAL agent processes (each a full ``tpurun``
+    supervision tree with its own trainer) against one shared,
+    journal-backed master subprocess — the harness shape the
+    node-subset partition and multi-node recovery scenarios need.
+    Every process arms the same scenario via ``DLROVER_CHAOS``; rules
+    target a subset with ``env_equals: {"DLROVER_NODE_RANK": ...}``.
+    One event log collects the whole job, and the invariants decide
+    from it alone."""
+    from dlrover_tpu.common.comm import addr_connected, find_free_port
+
+    scenario = load_scenario(scenario)
+    opts = RUN_OPTIONS.get(scenario.name, {})
+    if total_steps is None:
+        total_steps = int(opts.get("total_steps", 10))
+    if ckpt_every is None:
+        ckpt_every = int(opts.get("ckpt_every", 2))
+    step_sleep = float(opts.get("step_sleep", 0.0))
+    warm_restart = warm_restart or bool(opts.get("warm_restart"))
+    os.makedirs(workdir, exist_ok=True)
+    spec_path = os.path.join(workdir, "chaos_scenario.json")
+    with open(spec_path, "w") as f:
+        json.dump(scenario.to_dict(), f, indent=2)
+    script = os.path.join(workdir, "chaos_train.py")
+    with open(script, "w") as f:
+        f.write(CHAOS_TRAIN_SCRIPT)
+    event_log = os.path.join(workdir, "events.jsonl")
+
+    base_env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        **{
+            _chaos.CHAOS_ENV: spec_path,
+            EVENT_LOG_ENV: event_log,
+            TOTAL_STEPS_ENV: str(total_steps),
+            CKPT_EVERY_ENV: str(ckpt_every),
+        },
+    )
+    if step_sleep:
+        base_env[STEP_SLEEP_ENV] = str(step_sleep)
+    if opts.get("shard_dataset"):
+        # same contract as the single-node path: one sample per
+        # shard, one shard per step — without it a shard-driven
+        # scenario would silently run the plain loop and inject
+        # nothing
+        base_env[SHARD_DATASET_ENV] = str(total_steps)
+    base_env.update(opts.get("extra_env", {}))
+    # the framework must be importable in the subprocesses even when
+    # not pip-installed (the caller may run from anywhere)
+    import dlrover_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(dlrover_tpu.__file__))
+    prev_pp = base_env.get("PYTHONPATH", "")
+    if pkg_root not in prev_pp.split(os.pathsep):
+        base_env["PYTHONPATH"] = (
+            f"{pkg_root}{os.pathsep}{prev_pp}" if prev_pp else pkg_root
+        )
+    port = find_free_port()
+    addr = f"127.0.0.1:{port}"
+    master_env = dict(
+        base_env,
+        DLROVER_MASTER_JOURNAL_DIR=os.path.join(
+            workdir, "master_journal"
+        ),
+        DLROVER_RESTART_COUNT="0",
+    )
+    master = subprocess.Popen(  # noqa: S603
+        [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--port", str(port), "--node_num", str(nnodes),
+        ],
+        env=master_env,
+    )
+    agents: List[subprocess.Popen] = []
+    logs: List = []
+    rc = 0
+    try:
+        deadline = time.time() + 30
+        while not addr_connected(addr):
+            if master.poll() is not None or time.time() > deadline:
+                raise RuntimeError("multinode master failed to start")
+            time.sleep(0.2)
+        for rank in range(nnodes):
+            env = dict(
+                base_env,
+                DLROVER_MASTER_ADDR=addr,
+                DLROVER_NODE_RANK=str(rank),
+                DLROVER_NODE_ID=str(rank),
+                DLROVER_SHARED_DIR=os.path.join(
+                    workdir, f"sock{rank}"
+                ),
+                DLROVER_METRICS_FILE=os.path.join(
+                    workdir, f"metrics_{rank}.json"
+                ),
+            )
+            out = open(
+                os.path.join(workdir, f"agent{rank}.log"), "w"
+            )
+            logs.append(out)
+            argv = [
+                sys.executable, "-m", "dlrover_tpu.run",
+                "--nnodes", str(nnodes),
+                "--nproc_per_node", "1",
+                f"--max_restarts={max_restarts}",
+                f"--monitor_interval={monitor_interval}",
+                "--node_rank", str(rank),
+            ]
+            if warm_restart:
+                argv.append("--warm-restart")
+            argv += [script, os.path.join(workdir, f"ckpt{rank}")]
+            agents.append(subprocess.Popen(  # noqa: S603
+                argv, env=env, stdout=out, stderr=subprocess.STDOUT,
+            ))
+        deadline = time.time() + timeout
+        for p in agents:
+            try:
+                p.wait(timeout=max(1.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                rc = rc or 124
+            rc = rc or (p.returncode or 0)
+    finally:
+        for p in agents:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if master.poll() is None:
+            master.terminate()
+            try:
+                master.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master.kill()
+        for out in logs:
+            try:
+                out.close()
+            except OSError:
+                pass
+
+    events = list(read_events(event_log)) if os.path.exists(
+        event_log
+    ) else []
+    report = ChaosRunReport(
+        scenario=scenario.name,
+        seed=scenario.seed,
+        rc=rc,
+        workdir=workdir,
+        event_log=event_log,
+        events=events,
+        timeline=timeline_from_events(events),
+    )
+    checks = (
+        invariants if invariants is not None
+        else default_multinode_invariants(
+            nnodes, total_steps, workdir, faulted_rank=faulted_rank
         )
     )
     for inv in checks:

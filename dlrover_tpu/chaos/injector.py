@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from dlrover_tpu.chaos import primitives
 from dlrover_tpu.chaos.schedule import RuleState, Scenario, load_scenario
+from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry.events import emit_event
 from dlrover_tpu.telemetry.metrics import get_registry
@@ -116,6 +117,11 @@ class ChaosInjector:
             rule=inj.rule,
             action=inj.action,
             step=inj.step,
+            # per-process discriminator: multi-agent scenarios (node-
+            # subset partitions) need to tell WHICH node injected —
+            # two processes with the same source would otherwise
+            # collide on (source, seq) in the timeline
+            node_rank=env_utils.get_node_rank(),
         )
         _INJECTIONS_TOTAL.inc(point=point, action=fired.rule.action)
         logger.warning(

@@ -78,6 +78,17 @@ class Rule:
     # duration window; resolved to an int in __post_init__
     max_count: Optional[int] = None
     only_first_incarnation: bool = False
+    # fire only in the worker incarnation whose restart_count equals
+    # this (generalizes only_first_incarnation: scheduled-churn
+    # scenarios kill incarnation 0 at step A, incarnation 1 at step
+    # B, ... without re-killing a respawn replaying A)
+    incarnation: Optional[int] = None
+    # fire only in processes whose environment matches every pair —
+    # how a rule targets a SUBSET of a multi-process job: one node of
+    # a multi-agent partition ({"DLROVER_NODE_RANK": "1"}), one
+    # forkserver template generation
+    # ({"DLROVER_FORKSERVER_GENERATION": "1"})
+    env_equals: Dict[str, str] = field(default_factory=dict)
     args: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -149,7 +160,7 @@ class RuleState:
         matched point executes the rule's action."""
         rule = self.rule
         self.calls += 1
-        if rule.only_first_incarnation:
+        if rule.only_first_incarnation or rule.incarnation is not None:
             # hook sites that KNOW the incarnation pass it in ctx (the
             # agent supervises restarts but never carries the env var
             # itself — it only exports it to spawned workers); other
@@ -157,8 +168,15 @@ class RuleState:
             restart_count = ctx.get("restart_count")
             if restart_count is None:
                 restart_count = env_utils.get_restart_count()
-            if restart_count > 0:
+            if rule.only_first_incarnation and restart_count > 0:
                 return False
+            if (rule.incarnation is not None
+                    and restart_count != rule.incarnation):
+                return False
+        if rule.env_equals:
+            for key, want in rule.env_equals.items():
+                if os.environ.get(key, "") != str(want):
+                    return False
         # an open duration window fires until it closes — or until an
         # explicit max_count bounds the blast radius mid-window
         if self.window_opened_at is not None:
@@ -209,7 +227,7 @@ class Scenario:
             rd: Dict[str, Any] = {"point": r.point, "action": r.action}
             for key in (
                 "name", "at_step", "step_window", "after_calls",
-                "after_time", "prob",
+                "after_time", "prob", "incarnation",
             ):
                 val = getattr(r, key)
                 if val not in (None, ""):
@@ -220,6 +238,8 @@ class Scenario:
                 rd["max_count"] = r.max_count
             if r.only_first_incarnation:
                 rd["only_first_incarnation"] = True
+            if r.env_equals:
+                rd["env_equals"] = dict(r.env_equals)
             if r.args:
                 rd["args"] = dict(r.args)
             out["rules"].append(rd)

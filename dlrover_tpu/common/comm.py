@@ -37,7 +37,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from dlrover_tpu import chaos as _chaos
-from dlrover_tpu.common.constants import GRPC
+from dlrover_tpu.common.constants import GRPC, NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry import tracing as _tracing
 from dlrover_tpu.telemetry.metrics import get_registry as _get_registry
@@ -50,6 +50,15 @@ _RPC_RECONNECTS_TOTAL = _get_registry().counter(
     "dlrover_rpc_client_reconnects_total",
     "TCP connections the client established (first + after drops)",
 )
+_RPC_RESYNC_PARKS_TOTAL = _get_registry().counter(
+    "dlrover_rpc_resync_parks_total",
+    "Roundtrips that exhausted retries and parked awaiting a "
+    "master respawn",
+)
+_RPC_RESYNC_RECONNECTS_TOTAL = _get_registry().counter(
+    "dlrover_rpc_resync_reconnects_total",
+    "Parked clients that found the master back and resumed",
+)
 
 # reconnect-hardening knobs (chaos partition scenarios hammer this
 # path; prod defaults preserve the former envelope: 0.5 s doubling,
@@ -57,6 +66,13 @@ _RPC_RECONNECTS_TOTAL = _get_registry().counter(
 RPC_RETRIES_ENV = "DLROVER_RPC_RETRIES"
 RPC_BACKOFF_BASE_ENV = "DLROVER_RPC_BACKOFF_BASE"
 RPC_BACKOFF_MAX_ENV = "DLROVER_RPC_BACKOFF_MAX"
+# master crash recovery: when > 0, a client whose retry envelope is
+# exhausted does NOT give up — it parks in a bounded re-resolve/
+# reconnect loop (the master may be respawning; its address may have
+# moved, so DLROVER_MASTER_ADDR is re-read every probe) and, once the
+# master answers again, replays a session-resync handshake before
+# resuming the original request
+RPC_RESYNC_TIMEOUT_ENV = "DLROVER_MASTER_RESYNC_TIMEOUT"
 
 
 def compute_backoff(
@@ -354,6 +370,7 @@ class MessageClient:
         retries: Optional[int] = None,
         backoff_base: Optional[float] = None,
         backoff_max: Optional[float] = None,
+        resync_timeout: Optional[float] = None,
     ):
         self._addr = addr
         self._node_id = node_id
@@ -371,9 +388,26 @@ class MessageClient:
             backoff_max if backoff_max is not None
             else _env_float(RPC_BACKOFF_MAX_ENV, 8.0)
         )
+        # 0 disables the park-for-respawn loop (the generic default:
+        # ad-hoc clients should fail fast); the agent's MasterClient
+        # turns it on so a master crash/restart is survivable
+        self._resync_timeout = (
+            resync_timeout if resync_timeout is not None
+            else _env_float(RPC_RESYNC_TIMEOUT_ENV, 0.0)
+        )
+        self._session_resync_cb = None
+        self._in_resync = False
+        self._last_resync = -1e9
         self._rng = random.Random()
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+
+    def set_session_resync(self, callback):
+        """Register the handshake replayed after a master comes back
+        from a crash (the agent's MasterClient sends node id, restart
+        count, last reported step and last acked task so the recovered
+        master rebuilds live state without restarting trainers)."""
+        self._session_resync_cb = callback
 
     def _connect(self) -> socket.socket:
         host, port = self._addr.rsplit(":", 1)
@@ -383,7 +417,96 @@ class MessageClient:
         return sock
 
     def _roundtrip(self, verb: str, message):
-        """One logical request with bounded, jittered-backoff retries.
+        """One logical request, surviving both transient drops and a
+        full master crash/restart.
+
+        The inner attempt loop walks the jittered-backoff envelope.
+        When it is exhausted and a resync window is configured, the
+        client parks: it re-resolves the master address and probes
+        reachability until the (re)spawned master answers or the
+        window closes, replays the session-resync handshake, then
+        retries the request — same req id, so a request the dead
+        master executed-but-never-acked is answered from the response
+        cache (or harmlessly re-executed by the recovered master,
+        whose journal replay made the handlers idempotent)."""
+        # one id for all attempts: a retry of an executed-but-unacked
+        # request is answered from the server's response cache
+        req_id = uuid.uuid4().hex
+        try:
+            return self._attempt_loop(verb, message, req_id)
+        except (ConnectionError, OSError) as e:
+            if self._resync_timeout <= 0:
+                raise
+            if not self._await_master(e):
+                raise ConnectionError(
+                    f"master at {self._addr} did not come back within "
+                    f"the {self._resync_timeout:.0f}s resync window: "
+                    f"{e}"
+                ) from e
+            if not self._in_resync:
+                self._run_session_resync()
+            return self._attempt_loop(verb, message, req_id)
+
+    def _await_master(self, cause: Exception) -> bool:
+        """Bounded re-resolve/reconnect park: the master process died
+        (or a long partition outlived the retry envelope).  Re-read
+        the ambient master address every probe — a respawned master
+        may come back elsewhere — and return once it accepts
+        connections."""
+        _RPC_RESYNC_PARKS_TOTAL.inc()
+        logger.warning(
+            "master at %s unreachable (%s); parking up to %.0fs for "
+            "a respawn", self._addr, cause, self._resync_timeout,
+        )
+        deadline = time.monotonic() + self._resync_timeout
+        while time.monotonic() < deadline:
+            env_addr = os.environ.get(NodeEnv.MASTER_ADDR, "")
+            if env_addr and env_addr != self._addr:
+                logger.warning(
+                    "master address re-resolved: %s -> %s",
+                    self._addr, env_addr,
+                )
+                self._addr = env_addr
+            if addr_connected(self._addr, timeout=1.0):
+                _RPC_RESYNC_RECONNECTS_TOTAL.inc()
+                logger.info(
+                    "master back at %s; resuming", self._addr
+                )
+                return True
+            time.sleep(0.2 + self._rng.uniform(0.0, 0.2))
+        return False
+
+    def _run_session_resync(self):
+        cb = self._session_resync_cb
+        if cb is None:
+            return
+        self._in_resync = True
+        try:
+            cb()
+        except Exception as e:  # noqa: BLE001 - the resync is
+            # best-effort state rebuild; the original request decides
+            # success
+            logger.warning("session resync handshake failed: %s", e)
+        finally:
+            self._in_resync = False
+
+    def _note_recovered(self):
+        """A request succeeded AFTER at least one connection-level
+        failure: the master may be a respawned incarnation that knows
+        nothing of this session (its response cache and live state
+        died with its predecessor), so replay the resync handshake.
+        Rate-limited: a flaky window produces many reconnects but one
+        handshake rebuilds everything."""
+        if self._session_resync_cb is None or self._in_resync:
+            return
+        now = time.monotonic()
+        if now - self._last_resync < 2.0:
+            return
+        self._last_resync = now
+        self._run_session_resync()
+
+    def _attempt_loop(self, verb: str, message, req_id: str):
+        """Bounded, jittered-backoff retries of one request.
 
         Every attempt may fail at connect, send or receive — repeated
         connect failures (master rescheduling, RPC partition) walk the
@@ -392,9 +515,6 @@ class MessageClient:
         lockstep, and the final attempt raises immediately instead of
         paying one more backoff it can never use."""
         last_err: Optional[Exception] = None
-        # one id for all attempts: a retry of an executed-but-unacked
-        # request is answered from the server's response cache
-        req_id = uuid.uuid4().hex
         for attempt in range(self._retries):
             try:
                 # chaos hook: a drop/partition rule raises
@@ -420,6 +540,11 @@ class MessageClient:
                     resp = _recv_frame(self._sock)
                 if isinstance(resp, Exception):
                     raise resp
+                if last_err is not None:
+                    # recovered after a connection-level failure: the
+                    # server may be a fresh master incarnation —
+                    # replay the session-resync handshake
+                    self._note_recovered()
                 return resp
             except (ConnectionError, OSError) as e:
                 last_err = e

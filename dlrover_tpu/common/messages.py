@@ -311,5 +311,34 @@ class JobExitRequest(Message):
     reason: str = ""
 
 
+@dataclass
+class SessionResyncRequest(Message):
+    """Agent -> recovered master handshake: everything the master
+    needs to rebuild this node's live state after a crash/restart —
+    identity, incarnation, and the last durable progress marks — so
+    healthy trainers keep running instead of being restarted."""
+
+    node_id: int = 0
+    node_rank: int = 0
+    node_type: str = "worker"
+    local_world_size: int = 1
+    restart_count: int = 0
+    last_step: int = 0
+    last_acked_dataset: str = ""
+    last_acked_task: int = -1
+
+
+@dataclass
+class SessionResyncResponse(Message):
+    """``incarnation`` identifies the master process instance; a
+    change tells the agent a recovery happened (it logs/emits, it
+    does NOT restart healthy workers)."""
+
+    incarnation: str = ""
+    rdzv_round: int = 0
+    recoveries: int = 0
+    success: bool = True
+
+
 # (node_id, node_type, message) -> response message tuple alias
 Request = Tuple[int, str, Message]

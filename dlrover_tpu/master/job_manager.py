@@ -36,7 +36,31 @@ class JobManager:
         self._heartbeat_thread: Optional[threading.Thread] = None
         # callbacks fired with NodeEvent on status transitions
         self._event_callbacks: List[Callable[[NodeEvent], None]] = []
-        self.job_exit_reason = ""
+        self._job_exit_reason = ""
+        # master crash recovery: node transitions and terminal exit
+        # decisions are journaled when a StateJournal is attached;
+        # _terminal_decisions carries decisions across a master
+        # restart so a LATE report referencing the pre-restart
+        # incarnation cannot overwrite/resurrect them
+        self.journal = None
+        self._terminal_decisions: Dict[int, str] = {}
+
+    def _jot(self, kind: str, data: Dict):
+        if self.journal is not None:
+            self.journal.append(kind, data)
+
+    @property
+    def job_exit_reason(self) -> str:
+        return self._job_exit_reason
+
+    @job_exit_reason.setter
+    def job_exit_reason(self, reason: str):
+        """The job-level terminal decision is durable the moment it is
+        made: a respawned master honors it instead of resurrecting an
+        aborted job."""
+        if reason and reason != self._job_exit_reason:
+            self._jot("job_exit", {"reason": reason})
+        self._job_exit_reason = reason
 
     # -- registry ----------------------------------------------------------
 
@@ -75,9 +99,32 @@ class JobManager:
             # relaunch path) must not re-handle an already-seen death
             # delivered again by a @retry_request'd agent report
             return False
+        if (
+            node_id in self._terminal_decisions
+            and old in NodeStatus.end_states()
+        ):
+            # the journaled terminal decision for this node already
+            # stands (possibly made by the PRE-RESTART master): a
+            # late exit report from the old incarnation must not
+            # rewrite the status/exit_reason it was decided on
+            logger.info(
+                "ignoring late status %r for node %s: terminal "
+                "decision %r is journaled",
+                status, node_id, self._terminal_decisions[node_id],
+            )
+            return False
         node.update_status(status)
         if exit_reason:
             node.exit_reason = exit_reason
+        self._jot(
+            "node",
+            {
+                "id": node_id,
+                "type": node_type,
+                "status": status,
+                "exit_reason": node.exit_reason,
+            },
+        )
         event_type = (
             NodeEventType.DELETED
             if status in NodeStatus.end_states()
@@ -104,6 +151,20 @@ class JobManager:
         the distributed manager additionally starts replacement
         placement immediately."""
         node = self.add_node(node_type, node_id)
+        if (
+            node.status in NodeStatus.end_states()
+            or node_id in self._terminal_decisions
+        ):
+            # a late notice referencing a pre-restart incarnation (or
+            # one that lost the race against the real exit): the
+            # journaled terminal decision stands — overwriting
+            # exit_reason here would turn a FATAL_ERROR decline into
+            # a relaunchable PREEMPTED across the restart boundary
+            logger.info(
+                "ignoring late preemption notice for node %s: "
+                "terminal decision already recorded", node_id,
+            )
+            return
         node.exit_reason = NodeExitReason.PREEMPTED
         logger.info(
             "advance preemption notice for node %s (%s); node stays "
@@ -180,6 +241,99 @@ class JobManager:
             )
             return False
         return relaunch
+
+    # -- master crash recovery (state journal) -----------------------------
+
+    def record_exit_decision(self, node_id: int, decision: str,
+                             reason: str = ""):
+        """Durably record a per-node terminal decision (relaunch
+        declined, budget exhausted, job abort) so it survives a
+        master restart and late reports cannot overwrite it."""
+        self._terminal_decisions[node_id] = decision
+        self._jot(
+            "decision",
+            {"node_id": node_id, "decision": decision,
+             "reason": reason},
+        )
+
+    def snapshot_state(self) -> Dict:
+        with self._lock:
+            nodes = [
+                {
+                    "id": n.id,
+                    "type": n.type,
+                    "rank": n.rank_index,
+                    "status": n.status,
+                    "exit_reason": n.exit_reason,
+                    "relaunch_count": n.relaunch_count,
+                    "max_relaunch_count": n.max_relaunch_count,
+                    "relaunchable": n.relaunchable,
+                    "is_released": n.is_released,
+                    "critical": n.critical,
+                }
+                for n in self._nodes.values()
+            ]
+        return {
+            "nodes": nodes,
+            "decisions": dict(self._terminal_decisions),
+            "job_exit_reason": self._job_exit_reason,
+        }
+
+    def restore_state(self, state: Dict):
+        for rec in state.get("nodes", []):
+            node = self.add_node(
+                rec.get("type", NodeType.WORKER),
+                int(rec["id"]),
+                int(rec.get("rank", -1)),
+            )
+            node.status = rec.get("status", node.status)
+            node.exit_reason = rec.get("exit_reason", "")
+            node.relaunch_count = int(rec.get("relaunch_count", 0))
+            node.max_relaunch_count = int(
+                rec.get("max_relaunch_count", node.max_relaunch_count)
+            )
+            node.relaunchable = bool(rec.get("relaunchable", True))
+            node.is_released = bool(rec.get("is_released", False))
+            node.critical = bool(rec.get("critical", False))
+            # fresh heartbeat grace: the outage must not read as node
+            # silence — live agents re-confirm on their next beat
+            if node.status == NodeStatus.RUNNING:
+                node.heartbeat_time = time.time()
+        self._terminal_decisions.update(
+            {int(k): v for k, v in
+             (state.get("decisions") or {}).items()}
+        )
+        reason = state.get("job_exit_reason", "")
+        if reason:
+            self._job_exit_reason = reason
+
+    def apply_journal_entry(self, kind: str, data: Dict) -> bool:
+        """Replay one incremental record.  Transitions are applied
+        directly (no event callbacks: shard recycling and rendezvous
+        membership are rebuilt from their own journaled records, and
+        re-firing callbacks here would double-apply them)."""
+        if kind == "node":
+            node = self.add_node(
+                data.get("type", NodeType.WORKER), int(data["id"])
+            )
+            node.status = data.get("status", node.status)
+            if data.get("exit_reason"):
+                node.exit_reason = data["exit_reason"]
+            if node.status == NodeStatus.RUNNING:
+                node.heartbeat_time = time.time()
+            return True
+        if kind == "decision":
+            self._terminal_decisions[int(data["node_id"])] = data.get(
+                "decision", ""
+            )
+            node = self.get_node(int(data["node_id"]))
+            if node is not None:
+                node.is_released = True
+            return True
+        if kind == "job_exit":
+            self._job_exit_reason = data.get("reason", "")
+            return True
+        return False
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -1,0 +1,345 @@
+"""Crash-consistent master state journal.
+
+Role of the reference's master persistence (``dlrover/python/master/
+servicer.py`` + ``master_kv_store.py``, which survive master restarts
+by writing job/task state to a KV store): the master is the one
+process with no supervisor-level recovery story, so every control-
+plane mutation — node table transitions, rendezvous round
+completions, dataset shard dispatch/ack, KV writes, terminal exit
+decisions — is journaled to an append-only, checksummed record log
+the respawned master replays.
+
+On-disk layout (``DLROVER_MASTER_JOURNAL_DIR``)::
+
+    snapshot.json      last full-state snapshot (atomic tmp+rename)
+    snapshot.json.bak  previous snapshot (fallback if the last one
+                       is unreadable)
+    journal.log        MAGIC header + incremental records since the
+                       snapshot
+
+Record framing: ``>II`` (payload length, CRC32 of payload) followed by
+the UTF-8 JSON payload ``{"s": seq, "k": kind, "d": data}``.  Appends
+are flushed and ``fsync``'d before the mutation is acknowledged, so a
+SIGKILL never loses an acked record.  Replay reads records until the
+first length/CRC mismatch or EOF — a torn tail (the crash interrupted
+the final write) truncates to the last whole record instead of
+raising, which makes recovery *prefix-consistent*: either a record is
+fully visible or it (and everything after it) is gone; a decision
+that was never durably written can never be resurrected.
+
+Sequence numbers make snapshot+log replay idempotent: the snapshot
+stores the seq it folded in, and replay skips log records at or below
+it, so a crash between "snapshot renamed" and "log truncated" cannot
+double-apply entries.
+"""
+
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry import tracing as trace
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+
+JOURNAL_DIR_ENV = "DLROVER_MASTER_JOURNAL_DIR"
+
+MAGIC = b"DLRVJRN1\n"
+_REC = struct.Struct(">II")  # payload length, CRC32(payload)
+_LOG_NAME = "journal.log"
+_SNAP_NAME = "snapshot.json"
+
+_REG = get_registry()
+_ENTRIES_TOTAL = _REG.counter(
+    "dlrover_master_journal_entries_total",
+    "Journal records appended, by kind",
+)
+_FSYNC_SECONDS = _REG.histogram(
+    "dlrover_master_journal_fsync_seconds",
+    "Durability cost of one journal append (flush + fsync)",
+)
+_SNAPSHOTS_TOTAL = _REG.counter(
+    "dlrover_master_journal_snapshots_total",
+    "Full-state snapshots written (log rotations)",
+)
+
+
+@dataclass
+class JournalReplay:
+    """What a respawned master gets back from the journal."""
+
+    snapshot: Optional[Dict[str, Any]] = None
+    snapshot_seq: int = 0
+    entries: List[Tuple[int, str, Dict[str, Any]]] = field(
+        default_factory=list
+    )
+    last_seq: int = 0
+    truncated: bool = False  # a torn/corrupt tail was discarded
+    good_offset: int = 0  # byte offset of the last whole record
+
+    @property
+    def has_state(self) -> bool:
+        return self.snapshot is not None or bool(self.entries)
+
+
+def _snapshot_doc(seq: int, state: Dict[str, Any]) -> bytes:
+    body = json.dumps({"seq": seq, "state": state}, default=str)
+    crc = zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+    return json.dumps({"crc": crc, "doc": body}).encode("utf-8")
+
+
+def _read_snapshot(path: str) -> Optional[Tuple[int, Dict[str, Any]]]:
+    try:
+        with open(path, "rb") as f:
+            wrapper = json.loads(f.read().decode("utf-8"))
+        body = wrapper["doc"]
+        crc = zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+        if crc != int(wrapper["crc"]):
+            logger.warning("journal snapshot %s failed CRC", path)
+            return None
+        doc = json.loads(body)
+        return int(doc["seq"]), doc["state"]
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _iter_frames(blob: bytes):
+    """Yield ``(seq, record_dict, raw_frame_bytes)`` for each whole,
+    CRC-valid record in a log blob; stops at the first torn/corrupt
+    frame.  The single framing walk shared by replay and rotation —
+    both must agree on where the valid prefix ends.  Raw frame bytes
+    let rotation re-write surviving records without re-encoding."""
+    if not blob.startswith(MAGIC):
+        return
+    off = len(MAGIC)
+    while off + _REC.size <= len(blob):
+        length, crc = _REC.unpack_from(blob, off)
+        start = off + _REC.size
+        end = start + length
+        if length > 64 * 1024 * 1024 or end > len(blob):
+            return
+        payload = blob[start:end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return
+        try:
+            rec = json.loads(payload.decode("utf-8"))
+            seq = int(rec["s"])
+        except (ValueError, KeyError, TypeError):
+            return
+        yield seq, rec, blob[off:end]
+        off = end
+
+
+def replay_dir(journal_dir: str) -> JournalReplay:
+    """Read snapshot + log back into a :class:`JournalReplay`.
+
+    Never raises past recovery: an unreadable snapshot falls back to
+    the previous one (``.bak``); a torn or corrupted log tail ends the
+    entry list at the last whole record (prefix consistency)."""
+    out = JournalReplay()
+    with trace.span("journal.replay", dir=journal_dir):
+        snap_path = os.path.join(journal_dir, _SNAP_NAME)
+        snap = _read_snapshot(snap_path)
+        if snap is None:
+            snap = _read_snapshot(snap_path + ".bak")
+        if snap is not None:
+            out.snapshot_seq, out.snapshot = snap
+            out.last_seq = out.snapshot_seq
+        log_path = os.path.join(journal_dir, _LOG_NAME)
+        try:
+            with open(log_path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return out
+        if not blob.startswith(MAGIC):
+            if blob:
+                out.truncated = True
+            return out
+        out.good_offset = len(MAGIC)
+        for seq, rec, frame in _iter_frames(blob):
+            out.good_offset += len(frame)
+            if seq <= out.snapshot_seq or seq <= out.last_seq:
+                # already folded into the snapshot (crash between
+                # snapshot rename and log rotation), or a stale
+                # duplicate — skip, never double-apply
+                continue
+            out.entries.append(
+                (seq, str(rec.get("k", "")), rec.get("d") or {})
+            )
+            out.last_seq = seq
+        if out.good_offset != len(blob):
+            out.truncated = True
+        emit_event(
+            "journal_replay",
+            dir=journal_dir,
+            entries=len(out.entries),
+            snapshot_seq=out.snapshot_seq,
+            last_seq=out.last_seq,
+            truncated=out.truncated,
+        )
+    return out
+
+
+class StateJournal:
+    """Writer half: fsync'd appends + snapshot/log rotation.
+
+    Opening an existing directory first replays it (the result is kept
+    on ``self.recovered`` for the caller's restore path) and truncates
+    any torn tail so subsequent appends extend a clean prefix."""
+
+    def __init__(
+        self,
+        journal_dir: str,
+        fsync: bool = True,
+        snapshot_every: int = 512,
+    ):
+        self.dir = journal_dir
+        self._fsync = fsync
+        self.snapshot_every = max(1, snapshot_every)
+        os.makedirs(journal_dir, exist_ok=True)
+        self._log_path = os.path.join(journal_dir, _LOG_NAME)
+        self._snap_path = os.path.join(journal_dir, _SNAP_NAME)
+        self.recovered = replay_dir(journal_dir)
+        self._seq = self.recovered.last_seq
+        self.entries_since_snapshot = len(self.recovered.entries)
+        # one lock around every append/rotation: the journal is fed
+        # from many threads at once (RPC handler threads through the
+        # servicer/task/job managers, the heartbeat monitor, the
+        # run-loop's snapshot cadence) — an unsynchronized write would
+        # interleave frame bytes and CRC-poison the log
+        self._io_lock = threading.Lock()
+        fresh = not os.path.exists(self._log_path)
+        self._fh = open(self._log_path, "ab")
+        if fresh or self._fh.tell() == 0:
+            self._fh.write(MAGIC)
+            self._flush()
+        elif self.recovered.good_offset < len(MAGIC):
+            # torn/absent header (crash mid-header-write): nothing in
+            # this file is recoverable, and truncating to 9 garbage
+            # bytes would leave a log every future replay silently
+            # rejects — start a clean one
+            self._fh.close()
+            self._fh = open(self._log_path, "wb")
+            self._fh.write(MAGIC)
+            self._flush()
+        elif self.recovered.good_offset < self._fh.tell():
+            # discard the torn tail so the next append extends the
+            # recovered prefix instead of burying a record in garbage
+            # no replay would ever reach
+            self._fh.truncate(self.recovered.good_offset)
+            self._fh.seek(0, os.SEEK_END)
+            self._flush()
+
+    def _flush(self):
+        self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
+
+    @property
+    def last_seq(self) -> int:
+        return self._seq
+
+    def append(self, kind: str, data: Dict[str, Any]) -> int:
+        """Durably record one mutation; returns its seq.  The record
+        is on disk (fsync'd) when this returns.  Thread-safe: callers
+        are RPC handler threads, monitor threads and the run loop."""
+        t0 = time.monotonic()
+        with self._io_lock:
+            self._seq += 1
+            seq = self._seq
+            payload = json.dumps(
+                {"s": seq, "k": kind, "d": data}, default=str
+            ).encode("utf-8")
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            self._fh.write(_REC.pack(len(payload), crc) + payload)
+            self._flush()
+            self.entries_since_snapshot += 1
+        _FSYNC_SECONDS.observe(time.monotonic() - t0)
+        _ENTRIES_TOTAL.inc(kind=kind)
+        return seq
+
+    def snapshot(self, state: Dict[str, Any],
+                 seq: Optional[int] = None):
+        """Atomically persist a full-state snapshot and rotate the
+        log.  Crash-safe at every boundary: tmp rename is atomic, the
+        previous snapshot survives as ``.bak``, and seq filtering
+        makes a not-yet-rotated log harmless.
+
+        ``seq`` is the journal position observed BEFORE the caller
+        captured ``state``.  Appends that raced the capture (their
+        records carry a later seq) are PRESERVED through the rotation
+        and re-applied at replay on top of the snapshot — replay of
+        those kinds is idempotent, so a mid-capture mutation is at
+        worst double-applied, never lost.  (Exception: a ``kv_add``
+        racing the capture can double-count; KV barriers are
+        transient rendezvous aids, so the blast radius is nil.)"""
+        with self._io_lock:
+            snap_seq = self._seq if seq is None else int(seq)
+            doc = _snapshot_doc(snap_seq, state)
+            tmp = self._snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(doc)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(self._snap_path):
+                try:
+                    os.replace(
+                        self._snap_path, self._snap_path + ".bak"
+                    )
+                except OSError:
+                    pass
+            os.replace(tmp, self._snap_path)
+            self._fsync_dir()
+            # rotate: records at or below the snapshot's seq are
+            # redundant; anything later (an append that raced the
+            # state capture) must survive into the fresh log.  The
+            # rotation itself is crash-atomic: the new log is built
+            # in a tmp file, fsync'd, then renamed over the old one —
+            # a crash mid-rotation leaves the full old log, whose
+            # pre-snapshot records replay harmlessly (seq filter)
+            tail = b""
+            tail_count = 0
+            if snap_seq < self._seq:
+                self._fh.flush()
+                try:
+                    with open(self._log_path, "rb") as f:
+                        blob = f.read()
+                    for rec_seq, _rec, frame in _iter_frames(blob):
+                        if rec_seq > snap_seq:
+                            tail += frame
+                            tail_count += 1
+                except OSError:  # pragma: no cover - keep the old log
+                    return
+            tmp_log = self._log_path + ".tmp"
+            with open(tmp_log, "wb") as f:
+                f.write(MAGIC + tail)
+                f.flush()
+                os.fsync(f.fileno())
+            self._fh.close()
+            os.replace(tmp_log, self._log_path)
+            self._fsync_dir()
+            self._fh = open(self._log_path, "ab")
+            self.entries_since_snapshot = tail_count
+        _SNAPSHOTS_TOTAL.inc()
+
+    def _fsync_dir(self):
+        try:
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # pragma: no cover - fs without dir fsync
+            pass
+
+    def close(self):
+        with self._io_lock:
+            try:
+                self._fh.close()
+            except OSError:
+                pass

@@ -53,3 +53,29 @@ class KVStoreService:
     def clear(self):
         with self._lock:
             self._store.clear()
+
+    # -- crash recovery (master state journal) -------------------------
+
+    def dump(self) -> Dict[str, str]:
+        """JSON-safe copy of the store (values base64'd) for the
+        master journal's full-state snapshot."""
+        import base64
+
+        with self._lock:
+            return {
+                k: base64.b64encode(v).decode("ascii")
+                for k, v in self._store.items()
+            }
+
+    def load(self, dumped: Dict[str, str]):
+        """Restore a :meth:`dump` (journal replay); waiters on
+        restored keys are released."""
+        import base64
+
+        with self._cond:
+            for k, v in dumped.items():
+                try:
+                    self._store[k] = base64.b64decode(v)
+                except (ValueError, TypeError):
+                    continue
+            self._cond.notify_all()

@@ -5,10 +5,13 @@ master for the target platform, serve until the job exits.
 """
 
 import argparse
+import os
+import signal
 import sys
 
 from dlrover_tpu.common.constants import DefaultPorts
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.journal import JOURNAL_DIR_ENV
 from dlrover_tpu.master.master import JobMaster
 
 
@@ -22,6 +25,14 @@ def parse_args(argv=None):
         type=str,
         default="local",
         choices=["local", "kubernetes", "ray"],
+    )
+    parser.add_argument(
+        "--journal_dir",
+        type=str,
+        default=os.getenv(JOURNAL_DIR_ENV, ""),
+        help="crash-recovery state journal directory; a respawned "
+        "master pointed at the same directory replays it and resumes "
+        f"the job (also via {JOURNAL_DIR_ENV})",
     )
     return parser.parse_args(argv)
 
@@ -52,6 +63,7 @@ def create_master(args) -> JobMaster:
         return JobMaster(
             port=args.port, node_num=args.node_num,
             job_name=args.job_name,
+            journal_dir=args.journal_dir or None,
         )
     from dlrover_tpu.master.auto_scaler import AllreduceAutoScaler
     from dlrover_tpu.master.node_manager import DistributedJobManager
@@ -92,6 +104,18 @@ def create_master(args) -> JobMaster:
 
 def run(args) -> int:
     master = create_master(args)
+
+    def _graceful_exit(signum, _frame):
+        # a supervisor's SIGTERM is a planned shutdown: wake the run
+        # loop so it snapshots the journal and emits master_exit
+        # (goodput, final step) instead of dying mid-state
+        logger.info("signal %s: stopping master", signum)
+        master._stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful_exit)
+    except ValueError:  # pragma: no cover - non-main thread (tests)
+        pass
     master.prepare()
     return master.run()
 

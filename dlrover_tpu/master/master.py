@@ -13,6 +13,7 @@ scheduler-backed distributed flavour adds node watching/scaling on top
 import os
 import threading
 import time
+import uuid
 from typing import Optional
 
 from dlrover_tpu.common.comm import MessageServer, find_free_port
@@ -25,11 +26,13 @@ from dlrover_tpu.common.global_context import Context
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.diagnosis import DiagnosisManager
 from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.journal import JOURNAL_DIR_ENV, StateJournal
 from dlrover_tpu.master.kv_store import KVStoreService
 from dlrover_tpu.master.rdzv_manager import (
     ElasticTrainingRendezvousManager,
     NetworkCheckRendezvousManager,
 )
+from dlrover_tpu.master.recovery import capture_snapshot, restore_master
 from dlrover_tpu.master.servicer import MasterServicer
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
@@ -38,6 +41,13 @@ from dlrover_tpu.telemetry.exporter import (
     METRICS_AGGREGATE_ENV,
     METRICS_PORT_ENV,
     PrometheusEndpoint,
+)
+from dlrover_tpu.telemetry.metrics import get_registry
+
+_RECOVERIES_TOTAL = get_registry().counter(
+    "dlrover_master_recoveries_total",
+    "Master crash recoveries (journal replays into a respawned "
+    "master)",
 )
 
 
@@ -49,9 +59,14 @@ class JobMaster:
         job_name: str = "local-job",
         coordinator_port: int = 0,
         job_manager: Optional[JobManager] = None,
+        journal_dir: Optional[str] = None,
     ):
         self.job_name = job_name
         self.node_num = node_num
+        # a fresh id per master PROCESS: agents compare it across
+        # session resyncs to detect that a recovery happened
+        self.incarnation = uuid.uuid4().hex[:12]
+        self.recoveries = 0
         set_event_source("master")
         self.speed_monitor = SpeedMonitor()
         self.diagnosis_manager = DiagnosisManager()
@@ -95,6 +110,42 @@ class JobMaster:
             kv_store=self.kv_store,
             speed_monitor=self.speed_monitor,
         )
+        # -- crash recovery: state journal + replay --------------------
+        self.journal: Optional[StateJournal] = None
+        jdir = journal_dir or os.getenv(JOURNAL_DIR_ENV, "")
+        if jdir:
+            self.journal = StateJournal(jdir)
+            replayed = self.journal.recovered
+            if replayed.has_state:
+                stats = restore_master(self, replayed)
+                self.recoveries += 1
+                _RECOVERIES_TOTAL.inc()
+                emit_event(
+                    "master_recovered",
+                    job=self.job_name,
+                    incarnation=self.incarnation,
+                    recoveries=self.recoveries,
+                    rdzv_round=self.elastic_rdzv.current_round(),
+                    **stats,
+                )
+                logger.warning(
+                    "master recovered from journal %s: %s entries "
+                    "(%s re-queued shard leases), rdzv round %s, "
+                    "recovery #%s",
+                    jdir, stats["entries"], stats["requeued"],
+                    self.elastic_rdzv.current_round(),
+                    self.recoveries,
+                )
+            # attach AFTER replay so replayed mutations don't
+            # re-journal, then fold everything into a fresh snapshot
+            self.task_manager.journal = self.journal
+            self.job_manager.journal = self.journal
+            self.servicer.journal = self.journal
+            for mngr in self.rdzv_managers.values():
+                mngr.on_round_complete = self._journal_rdzv_round
+            self._snapshot_journal()
+        self.servicer.incarnation = self.incarnation
+        self.servicer.recoveries = self.recoveries
         self._server = MessageServer(port, self.servicer)
         self.port = self._server.port
         # one scrape of the master covers the whole job's
@@ -122,6 +173,26 @@ class JobMaster:
         self._stop = threading.Event()
         self._exit_code = 0
         self._run_thread: Optional[threading.Thread] = None
+
+    def _snapshot_journal(self):
+        """Fold current state into a snapshot.  The seq is read
+        BEFORE capture: a mutation journaled while the capture walks
+        the managers keeps its record through the rotation and is
+        re-applied (idempotently) at replay — raced mutations may be
+        double-applied, never lost."""
+        seq = self.journal.last_seq
+        self.journal.snapshot(capture_snapshot(self), seq=seq)
+
+    def _journal_rdzv_round(self, name, round_, participants):
+        if self.journal is not None:
+            self.journal.append(
+                "rdzv",
+                {
+                    "name": name,
+                    "round": round_,
+                    "participants": participants,
+                },
+            )
 
     def update_rdzv_params(
         self, min_nodes: int, max_nodes: int, node_unit: int = 1
@@ -156,7 +227,25 @@ class JobMaster:
         """Main poll loop (reference ``dist_master.py:211``)."""
         ctx = Context.instance()
         try:
+            if self.job_manager.job_exit_reason:
+                # a journaled terminal decision from the previous
+                # incarnation: honor it instead of resurrecting the job
+                logger.info(
+                    "journaled job exit decision honored: %s",
+                    self.job_manager.job_exit_reason,
+                )
+                if self.job_manager.job_exit_reason != (
+                    JobExitReason.SUCCEEDED
+                ):
+                    self._exit_code = 1
+                return self._exit_code
             while not self._stop.wait(ctx.seconds_to_check_hang):
+                if (
+                    self.journal is not None
+                    and self.journal.entries_since_snapshot
+                    >= self.journal.snapshot_every
+                ):
+                    self._snapshot_journal()
                 if self.servicer.exit_requested:
                     logger.info(
                         "job exit requested: %s", self.servicer.exit_requested
@@ -212,6 +301,18 @@ class JobMaster:
                     break
         finally:
             self.stop()
+            emit_event(
+                "master_exit",
+                job=self.job_name,
+                rc=self._exit_code,
+                exit_reason=(
+                    self.job_manager.job_exit_reason
+                    or self.servicer.exit_requested
+                ),
+                global_step=self.speed_monitor.completed_global_step,
+                goodput=round(self.speed_monitor.goodput(), 4),
+                recoveries=self.recoveries,
+            )
         return self._exit_code
 
     def run_in_thread(self):
@@ -230,6 +331,18 @@ class JobMaster:
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()
+        if self.journal is not None:
+            # graceful shutdown: fold the tail into a snapshot so a
+            # planned restart replays one file, then detach
+            try:
+                self._snapshot_journal()
+            except Exception:  # noqa: BLE001
+                logger.exception("final journal snapshot failed")
+            self.journal.close()
+            self.task_manager.journal = None
+            self.job_manager.journal = None
+            self.servicer.journal = None
+            self.journal = None
 
 
 # Back-compat aliases matching the reference's two flavours.

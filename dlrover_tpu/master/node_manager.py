@@ -110,6 +110,18 @@ class DistributedJobManager(JobManager):
         if not changed:
             return
         node.exit_reason = event.node.exit_reason
+        # watcher-observed transitions bypass update_node_status:
+        # journal them here or a respawned master would rebuild a
+        # node table missing every pod-watcher-driven change
+        self._jot(
+            "node",
+            {
+                "id": node.id,
+                "type": node.type,
+                "status": node.status,
+                "exit_reason": node.exit_reason,
+            },
+        )
         logger.info(
             "node %s -> %s (%s)", node.id, node.status,
             node.exit_reason or "-",
@@ -145,12 +157,13 @@ class DistributedJobManager(JobManager):
             # a node whose relaunch budget is exactly consumed would
             # otherwise hit the job-exit branch on the duplicate even
             # though its replacement already launched
-            return
+            return False
         node = self.get_node(node_id)
         if node is not None and node.status in (
             NodeStatus.FAILED, NodeStatus.DELETED
         ):
             self._handle_node_exit(node)
+        return changed
 
     def handle_preemption_notice(self, node_id: int, node_type: str):
         """ADVANCE notice from the agent's preemption monitor: start
@@ -164,6 +177,15 @@ class DistributedJobManager(JobManager):
         already handled — no double replacement, no job abort."""
         node = self.get_node(node_id)
         if node is None or node.is_released:
+            return
+        if node_id in self._terminal_decisions:
+            # journaled terminal decision (possibly from the
+            # pre-restart master incarnation): it stands — see the
+            # base manager's guard for the rationale
+            logger.info(
+                "ignoring late preemption notice for node %s: "
+                "terminal decision already recorded", node_id,
+            )
             return
         if node.status in NodeStatus.end_states():
             # the notice lost the race against the actual exit (the
@@ -200,14 +222,21 @@ class DistributedJobManager(JobManager):
                 node.is_released = True
         if relaunch:
             self._relaunch_node(node)
-        elif not already_handled and (
-            node.critical or self._all_relaunches_exhausted()
-        ):
-            # only the delivery that first handled this death may abort
-            # the job: a duplicate arriving after the relaunch claimed
-            # the node would see an exhausted budget and abort a job
-            # whose replacement is already running
-            self.job_exit_reason = node.exit_reason or "node_failed"
+        elif not already_handled:
+            # terminal: this node will not come back — journal the
+            # decision so a respawned master (and any late report
+            # from the pre-restart incarnation) honors it instead of
+            # re-deciding
+            self.record_exit_decision(
+                node.id, "no_relaunch", node.exit_reason
+            )
+            if node.critical or self._all_relaunches_exhausted():
+                # only the delivery that first handled this death may
+                # abort the job: a duplicate arriving after the
+                # relaunch claimed the node would see an exhausted
+                # budget and abort a job whose replacement is already
+                # running
+                self.job_exit_reason = node.exit_reason or "node_failed"
 
     def _should_relaunch(self, node: Node) -> bool:
         """Reference: _should_relaunch, dist_job_manager.py:561."""

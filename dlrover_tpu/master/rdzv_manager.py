@@ -84,6 +84,10 @@ class RendezvousManager:
         self._start_waiting_time = 0.0
         self._coordinator_port = 0
         self._topology_sorter = DpTopologySorter()
+        # master crash recovery: called (under the lock) with the
+        # completed round + participants so the state journal can
+        # record it; a respawned master restores via restore_round()
+        self.on_round_complete = None
 
     def set_topology_querier(self, querier):
         """Plug a fabric-coordinate source; the completed world is
@@ -205,6 +209,13 @@ class RendezvousManager:
             nodes=ranks,
             wait_s=round(wait_s, 3),
         )
+        if self.on_round_complete is not None:
+            try:
+                self.on_round_complete(
+                    self._name, self._rdzv_round, self._participants()
+                )
+            except Exception:  # noqa: BLE001 - journal must not kill rdzv
+                logger.exception("rdzv journal hook failed")
         logger.info(
             "%s rendezvous round %d completed with nodes %s",
             self._name,
@@ -212,6 +223,59 @@ class RendezvousManager:
             ranks,
         )
         return True
+
+    def _participants(self):
+        """Caller holds the lock: JSON-safe view of the completed
+        world, enough to rebuild it after a master restart."""
+        return {
+            str(rank): {
+                "node_id": meta.node_id,
+                "local_world_size": meta.local_world_size,
+                "node_ip": meta.node_ip,
+            }
+            for rank, meta in self._rdzv_nodes.items()
+        }
+
+    def current_round(self) -> int:
+        with self._lock:
+            return self._rdzv_round
+
+    def journal_state(self) -> Dict:
+        """Round + completed world for the journal snapshot."""
+        with self._lock:
+            return {
+                "round": self._rdzv_round,
+                "participants": self._participants(),
+            }
+
+    def restore_round(self, round_: int, participants: Dict) -> None:
+        """Master crash recovery: re-enter the journaled round with
+        its completed world, so healthy agents polling
+        ``get_comm_world`` keep getting the same answer and are NOT
+        restarted.  Participants that died during the outage are
+        pruned by the normal liveness paths (heartbeat timeout /
+        failure report -> remove_alive_node)."""
+        with self._lock:
+            self._rdzv_round = max(self._rdzv_round, int(round_))
+            self._rdzv_nodes = {}
+            for rank_s, meta in (participants or {}).items():
+                rank = int(rank_s)
+                self._rdzv_nodes[rank] = NodeMeta(
+                    node_id=int(meta.get("node_id", rank)),
+                    node_rank=rank,
+                    local_world_size=int(
+                        meta.get("local_world_size", 1)
+                    ),
+                    node_ip=str(meta.get("node_ip", "")),
+                )
+                self._alive_nodes.add(
+                    int(meta.get("node_id", rank))
+                )
+            self._latest_rdzv_nodes = sorted(self._rdzv_nodes)
+            self._rank_order = self._topology_sorter.sort(
+                self._rdzv_nodes
+            )
+            self._start_waiting_time = 0.0
 
     def num_nodes_waiting(self) -> int:
         """Agents poll this to detect pending membership changes

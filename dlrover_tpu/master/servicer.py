@@ -8,11 +8,13 @@ one gRPC ``report``/``get`` pair (``servicer.py:98,296``); this is the
 same design over the socket transport.
 """
 
+import base64
 import time
 from typing import Dict
 
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import RequestHandler
+from dlrover_tpu.telemetry.events import emit_event
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.job_manager import JobManager
@@ -45,6 +47,16 @@ class MasterServicer(RequestHandler):
         self.resource_stats: Dict[int, msg.NodeResourceStats] = {}
         self.model_info = msg.ModelInfo()
         self._exit_reason = ""
+        # master crash recovery: set by JobMaster when journaling is
+        # on.  ``incarnation`` identifies THIS master process; agents
+        # compare it across session resyncs to detect a recovery.
+        self.journal = None
+        self.incarnation = ""
+        self.recoveries = 0
+
+    def _jot(self, kind: str, data: Dict):
+        if self.journal is not None:
+            self.journal.append(kind, data)
 
     @property
     def elastic_rdzv(self) -> ElasticTrainingRendezvousManager:
@@ -110,8 +122,37 @@ class MasterServicer(RequestHandler):
             )
 
         if isinstance(message, msg.KeyValueAddRequest):
-            return msg.KeyValueAddResponse(
-                value=self._kv_store.add(message.key, message.amount)
+            value = self._kv_store.add(message.key, message.amount)
+            self._jot(
+                "kv_add",
+                {"key": message.key, "amount": message.amount},
+            )
+            return msg.KeyValueAddResponse(value=value)
+
+        if isinstance(message, msg.SessionResyncRequest):
+            # agent -> recovered-master handshake: rebuild this
+            # node's live state (liveness, rendezvous membership,
+            # progress marks) WITHOUT restarting its healthy trainers
+            self._job_manager.collect_heartbeat(message.node_id)
+            self.elastic_rdzv.add_alive_node(message.node_id)
+            if message.last_step > 0:
+                self._speed_monitor.collect_global_step(
+                    message.last_step
+                )
+            self._speed_monitor.add_running_worker(message.node_id)
+            emit_event(
+                "agent_resync",
+                node_id=message.node_id,
+                node_rank=message.node_rank,
+                restart_count=message.restart_count,
+                last_step=message.last_step,
+                last_acked_dataset=message.last_acked_dataset,
+                last_acked_task=message.last_acked_task,
+            )
+            return msg.SessionResyncResponse(
+                incarnation=self.incarnation,
+                rdzv_round=self.elastic_rdzv.current_round(),
+                recoveries=self.recoveries,
             )
 
         if isinstance(message, msg.GetShardTaskRequest):
@@ -136,22 +177,27 @@ class MasterServicer(RequestHandler):
             return msg.HeartbeatResponse()
 
         if isinstance(message, msg.NodeFailure):
-            relaunch = self._job_manager.handle_failure(
-                message.node_id,
-                message.restart_count,
-                message.error_data,
-                message.level,
+            return msg.BaseResponse(
+                success=self._handle_node_failure(message)
             )
-            # failed node's shards go back to the queue
-            self._task_manager.recycle_worker_tasks(message.node_id)
-            self.elastic_rdzv.remove_alive_node(message.node_id)
-            self._speed_monitor.remove_running_worker(message.node_id)
-            return msg.BaseResponse(success=relaunch)
 
         logger.warning("unhandled get message %s", type(message).__name__)
         return msg.BaseResponse(
             success=False, message=f"unhandled {type(message).__name__}"
         )
+
+    def _handle_node_failure(self, message: msg.NodeFailure) -> bool:
+        relaunch = self._job_manager.handle_failure(
+            message.node_id,
+            message.restart_count,
+            message.error_data,
+            message.level,
+        )
+        # failed node's shards go back to the queue
+        self._task_manager.recycle_worker_tasks(message.node_id)
+        self.elastic_rdzv.remove_alive_node(message.node_id)
+        self._speed_monitor.remove_running_worker(message.node_id)
+        return relaunch
 
     # ------------------------------------------------------------------
     # report: fire-and-ack
@@ -176,6 +222,15 @@ class MasterServicer(RequestHandler):
 
         if isinstance(message, msg.KeyValuePair):
             self._kv_store.set(message.key, message.value)
+            self._jot(
+                "kv_set",
+                {
+                    "key": message.key,
+                    "value": base64.b64encode(
+                        message.value or b""
+                    ).decode("ascii"),
+                },
+            )
             return True
 
         if isinstance(message, msg.GlobalStepRecord):
@@ -221,6 +276,15 @@ class MasterServicer(RequestHandler):
             )
             return True
 
+        if isinstance(message, msg.NodeFailure):
+            # the agent SENDS failures through the report verb
+            # (master_client.report_failure); they were only handled
+            # on the get path, so every agent-reported worker death
+            # fell through to "unhandled" — shards were never
+            # recycled and the dead node stayed in the rendezvous
+            # pool (surfaced by the multinode partition chaos run)
+            return self._handle_node_failure(message)
+
         if isinstance(message, msg.NodeResourceStats):
             self.resource_stats[message.node_id] = message
             return True
@@ -245,6 +309,9 @@ class MasterServicer(RequestHandler):
 
         if isinstance(message, msg.JobExitRequest):
             self._exit_reason = message.reason or "requested"
+            # terminal job decision: durable, so a respawned master
+            # honors it instead of resurrecting a finished job
+            self._jot("job_exit", {"reason": self._exit_reason})
             return True
 
         logger.warning("unhandled report message %s", type(message).__name__)

@@ -52,7 +52,11 @@ class SpeedMonitor:
         # (timestamp, global_step) samples
         self._samples: Deque[Tuple[float, int]] = deque(maxlen=window)
         self._global_step = 0
-        self._start_time = time.time()
+        # goodput wall-clock starts at the FIRST step report: master/
+        # agent startup idle is not churn loss, and measuring
+        # [first_step, last_step] matches bench.py's churn-window
+        # accounting (0.0 = no step seen yet)
+        self._start_time = 0.0
         self._last_step_time = time.time()
         self._batch_size = 0
         self._worker_adjustment_time = 0.0
@@ -111,6 +115,8 @@ class SpeedMonitor:
                             self._productive_seconds += min(gap, 60.0)
                         self._gap_window.append(gap)
                 self._last_productive_mark = ts
+                if not self._start_time:
+                    self._start_time = ts
                 self._global_step = step
                 self._last_step_time = ts
                 self._samples.append((ts, step))
@@ -118,11 +124,7 @@ class SpeedMonitor:
                 # diagnosis) see exactly what this monitor computed
                 self._step_gauge.set(step)
                 self._speed_gauge.set(self._running_speed_locked())
-                wall = time.time() - self._start_time
-                if wall > 0:
-                    self._goodput_gauge.set(
-                        min(1.0, self._productive_seconds / wall)
-                    )
+                self._goodput_gauge.set(self._goodput_locked())
 
     @property
     def completed_global_step(self) -> int:
@@ -164,15 +166,24 @@ class SpeedMonitor:
             / self._peak_flops
         )
 
+    def _goodput_locked(self) -> float:
+        """Productive fraction of the TRAINING window [first step,
+        last step] — the post-training tail (final persist, agent
+        shutdown) is not churn loss and must not dilute the ratio the
+        churn invariants assert on."""
+        if not self._start_time:
+            return 0.0
+        wall = self._last_step_time - self._start_time
+        if wall <= 0:
+            return 0.0
+        return min(1.0, self._productive_seconds / wall)
+
     def goodput(self) -> float:
-        """Fraction of wall-clock spent making step progress — the
-        north-star metric under churn (reference claim: 69% -> 95%
-        with fault tolerance + flash ckpt, README.md:55-57)."""
+        """Fraction of training wall-clock spent making step progress
+        — the north-star metric under churn (reference claim: 69% ->
+        95% with fault tolerance + flash ckpt, README.md:55-57)."""
         with self._lock:
-            wall = time.time() - self._start_time
-            if wall <= 0:
-                return 0.0
-            ratio = min(1.0, self._productive_seconds / wall)
+            ratio = self._goodput_locked()
             self._goodput_gauge.set(ratio)
             return ratio
 

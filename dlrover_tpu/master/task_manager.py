@@ -7,12 +7,14 @@ tasks whose worker died or stalled, and dataset position
 checkpoint/restore so a relaunched job resumes mid-epoch.
 """
 
+import dataclasses
 import json
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import DatasetShardParams, ShardTask
@@ -21,6 +23,7 @@ from dlrover_tpu.master.dataset_splitter import (
     Shard,
     new_dataset_splitter,
 )
+from dlrover_tpu.telemetry.events import emit_event
 
 _TASK_TIMEOUT = 1800.0
 
@@ -157,6 +160,111 @@ class BatchDatasetManager:
             )
             self._task_id += 1
 
+    # -- master crash recovery (state journal) -------------------------
+
+    def full_state(self) -> Dict:
+        """Exact internal state for the master journal's snapshot —
+        unlike :meth:`checkpoint` (the worker-facing dataset position)
+        it preserves in-flight leases with their task ids, so journal
+        records appended after the snapshot still resolve."""
+        return {
+            "epoch": self.splitter.epoch,
+            "completed": self._completed_count,
+            "next_task_id": self._task_id,
+            "todo": [(t.start, t.end) for t in self.todo],
+            "doing": [
+                {
+                    "task_id": tid,
+                    "worker": d.worker_id,
+                    "start": d.task.start,
+                    "end": d.task.end,
+                }
+                for tid, d in self.doing.items()
+            ],
+        }
+
+    def load_full_state(self, state: Dict):
+        self.splitter.epoch = int(state.get("epoch", 0))
+        self._completed_count = int(state.get("completed", 0))
+        self._task_id = int(state.get("next_task_id", 0))
+        self.todo = [
+            ShardTask(
+                task_id=-1,
+                task_type=self.task_type,
+                dataset_name=self.splitter.dataset_name,
+                start=start,
+                end=end,
+            )
+            for start, end in state.get("todo", [])
+        ]
+        self.doing = {}
+        for lease in state.get("doing", []):
+            task = ShardTask(
+                task_id=int(lease["task_id"]),
+                task_type=self.task_type,
+                dataset_name=self.splitter.dataset_name,
+                start=int(lease["start"]),
+                end=int(lease["end"]),
+            )
+            self.doing[task.task_id] = _DoingTask(
+                task, int(lease.get("worker", -1))
+            )
+
+    def replay_dispatch(
+        self, task_id: int, worker_id: int, start: int, end: int
+    ):
+        """Re-apply one journaled dispatch: move the (start, end)
+        shard from todo into a lease under the journaled task id.  The
+        splitters are deterministic (seeded shuffle), so refilling the
+        todo queue regenerates identical shards — indices included."""
+        self._fill_todo()
+        task: Optional[ShardTask] = None
+        for i, t in enumerate(self.todo):
+            if t.start == start and t.end == end:
+                task = self.todo.pop(i)
+                break
+        if task is None:
+            # a re-dispatch of a shard replay still holds in doing
+            # (recycle/timeout raced the journal order)
+            for tid, d in list(self.doing.items()):
+                if d.task.start == start and d.task.end == end:
+                    task = self.doing.pop(tid).task
+                    break
+        if task is None:
+            # state drift (e.g. restored from an older snapshot):
+            # rebuild the lease from the journaled range rather than
+            # losing the shard
+            task = ShardTask(
+                task_id=task_id,
+                task_type=self.task_type,
+                dataset_name=self.splitter.dataset_name,
+                start=start,
+                end=end,
+            )
+        task.task_id = task_id
+        self.doing[task_id] = _DoingTask(task, worker_id)
+        self._task_id = max(self._task_id, task_id + 1)
+
+    def replay_ack(self, task_id: int, success: bool):
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return
+        if success:
+            self._completed_count += 1
+            self.last_ack_time = time.time()
+        else:
+            self.todo.insert(0, doing.task)
+
+    def requeue_unacked(self) -> int:
+        """Recovery epilogue: every lease that was never acked goes
+        back to the head of the queue — delivered-but-unacked shards
+        are redone (at-least-once), acked shards never re-dispatch
+        (their ack is journaled), so none are lost and none complete
+        twice."""
+        stale = sorted(self.doing)
+        self.todo[:0] = [self.doing.pop(tid).task for tid in stale]
+        return len(stale)
+
 
 class StreamingDatasetManager(BatchDatasetManager):
     """Unbounded-stream dispatch (reference
@@ -210,6 +318,24 @@ class StreamingDatasetManager(BatchDatasetManager):
             self.splitter.partition_offsets.offsets = dict(offsets)
         self.splitter._emitted = state.get("emitted", 0)
 
+    def full_state(self) -> Dict:
+        state = super().full_state()
+        state["partition_offsets"] = dict(
+            self.splitter.partition_offsets.offsets
+        )
+        state["emitted"] = self.splitter._emitted
+        return state
+
+    def load_full_state(self, state: Dict):
+        super().load_full_state(state)
+        offsets = state.get("partition_offsets")
+        if offsets is not None:
+            # JSON round-trips dict keys as strings; partitions are ints
+            self.splitter.partition_offsets.offsets = {
+                int(k): v for k, v in offsets.items()
+            }
+        self.splitter._emitted = int(state.get("emitted", 0))
+
 
 class TaskManager:
     """Owns every dataset's manager (reference ``TaskManager:37``)."""
@@ -217,38 +343,54 @@ class TaskManager:
     def __init__(self, worker_restart_timeout: float = 0.0):
         self._lock = threading.Lock()
         self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._dataset_params: Dict[str, Dict] = {}
         self._worker_restart_timeout = worker_restart_timeout
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # speed-monitor hook: set by the master so task completion can
         # feed throughput accounting
         self.speed_monitor = None
+        # master crash recovery: when a StateJournal is attached every
+        # dispatch/ack/registration is durably recorded BEFORE the
+        # response leaves this process (journal.py)
+        self.journal = None
+
+    def _jot(self, kind: str, data: Dict):
+        if self.journal is not None:
+            self.journal.append(kind, data)
 
     def new_dataset(self, params: DatasetShardParams):
         with self._lock:
-            if params.dataset_name in self._datasets:
-                return
-            splitter = new_dataset_splitter(
-                storage_type=params.storage_type,
-                shuffle=params.shuffle,
-                batch_size=params.batch_size,
-                dataset_size=params.dataset_size,
-                num_epochs=params.num_epochs,
-                dataset_name=params.dataset_name,
-                num_minibatches_per_shard=params.num_minibatches_per_shard,
-            )
-            manager_cls = (
-                StreamingDatasetManager
-                if params.storage_type == "stream"
-                else BatchDatasetManager
-            )
-            self._datasets[params.dataset_name] = manager_cls(
-                params.task_type or TaskType.TRAINING, splitter
-            )
-            logger.info(
-                "new dataset %s registered (%s)",
-                params.dataset_name, manager_cls.__name__,
-            )
+            self._new_dataset_locked(params)
+
+    def _new_dataset_locked(self, params: DatasetShardParams):
+        if params.dataset_name in self._datasets:
+            return
+        splitter = new_dataset_splitter(
+            storage_type=params.storage_type,
+            shuffle=params.shuffle,
+            batch_size=params.batch_size,
+            dataset_size=params.dataset_size,
+            num_epochs=params.num_epochs,
+            dataset_name=params.dataset_name,
+            num_minibatches_per_shard=params.num_minibatches_per_shard,
+        )
+        manager_cls = (
+            StreamingDatasetManager
+            if params.storage_type == "stream"
+            else BatchDatasetManager
+        )
+        self._datasets[params.dataset_name] = manager_cls(
+            params.task_type or TaskType.TRAINING, splitter
+        )
+        self._dataset_params[params.dataset_name] = dataclasses.asdict(
+            params
+        )
+        self._jot("dataset", self._dataset_params[params.dataset_name])
+        logger.info(
+            "new dataset %s registered (%s)",
+            params.dataset_name, manager_cls.__name__,
+        )
 
     def get_dataset_task(
         self, worker_id: int, dataset_name: str
@@ -257,7 +399,41 @@ class TaskManager:
             ds = self._datasets.get(dataset_name)
             if ds is None:
                 return ShardTask(task_id=-1, task_type=TaskType.NONE)
-            return ds.get_task(worker_id)
+            task = ds.get_task(worker_id)
+            if task.task_id >= 0:
+                # journal the lease before the shard leaves the
+                # process: a crash after this line re-queues it, a
+                # crash before never handed it out — either way no
+                # shard is lost
+                self._jot(
+                    "dispatch",
+                    {
+                        "dataset": dataset_name,
+                        "task_id": task.task_id,
+                        "worker": worker_id,
+                        "start": task.start,
+                        "end": task.end,
+                    },
+                )
+                emit_event(
+                    "shard_dispatch",
+                    dataset=dataset_name,
+                    task_id=task.task_id,
+                    worker=worker_id,
+                    start=task.start,
+                    end=task.end,
+                )
+        if task.task_id >= 0:
+            # deterministic kill point for the master-recovery chaos
+            # scenarios: "the Nth shard dispatch" is stable across
+            # runs where wall-clock triggers are not
+            _chaos.fire(
+                "master.task_dispatch",
+                dataset=dataset_name,
+                task_id=task.task_id,
+                worker=worker_id,
+            )
+        return task
 
     def report_dataset_task(
         self, dataset_name: str, task_id: int, success: bool
@@ -266,7 +442,27 @@ class TaskManager:
             ds = self._datasets.get(dataset_name)
             if ds is None:
                 return False
-            return ds.report_task(task_id, success)
+            doing = ds.doing.get(task_id)
+            accepted = ds.report_task(task_id, success)
+            if accepted:
+                self._jot(
+                    "ack",
+                    {
+                        "dataset": dataset_name,
+                        "task_id": task_id,
+                        "success": bool(success),
+                    },
+                )
+                emit_event(
+                    "shard_ack",
+                    dataset=dataset_name,
+                    task_id=task_id,
+                    success=bool(success),
+                    start=doing.task.start if doing else -1,
+                    end=doing.task.end if doing else -1,
+                    worker=doing.worker_id if doing else -1,
+                )
+            return accepted
 
     def recycle_worker_tasks(self, worker_id: int):
         with self._lock:
@@ -296,7 +492,79 @@ class TaskManager:
             if ds is None:
                 return False
             ds.restore(json.loads(content))
+            self._jot(
+                "ds_restore",
+                {"dataset": dataset_name, "content": content},
+            )
             return True
+
+    # -- master crash recovery (state journal) -------------------------
+
+    def snapshot_state(self) -> Dict:
+        """Full sharding state for the journal snapshot."""
+        with self._lock:
+            return {
+                name: {
+                    "params": self._dataset_params.get(name, {}),
+                    "state": ds.full_state(),
+                }
+                for name, ds in self._datasets.items()
+            }
+
+    def restore_state(self, state: Dict):
+        """Load a journal snapshot (attach the journal only AFTER
+        restore/replay, or replayed mutations re-journal)."""
+        with self._lock:
+            for name, entry in state.items():
+                params = entry.get("params") or {}
+                if params:
+                    self._new_dataset_locked(
+                        DatasetShardParams(**params)
+                    )
+                ds = self._datasets.get(name)
+                if ds is not None:
+                    ds.load_full_state(entry.get("state") or {})
+
+    def apply_journal_entry(self, kind: str, data: Dict) -> bool:
+        """Re-apply one incremental journal record; returns whether
+        the kind belonged to this manager."""
+        if kind == "dataset":
+            self.new_dataset(DatasetShardParams(**data))
+            return True
+        if kind == "dispatch":
+            with self._lock:
+                ds = self._datasets.get(data.get("dataset", ""))
+                if ds is not None:
+                    ds.replay_dispatch(
+                        int(data["task_id"]),
+                        int(data.get("worker", -1)),
+                        int(data["start"]),
+                        int(data["end"]),
+                    )
+            return True
+        if kind == "ack":
+            with self._lock:
+                ds = self._datasets.get(data.get("dataset", ""))
+                if ds is not None:
+                    ds.replay_ack(
+                        int(data["task_id"]),
+                        bool(data.get("success", True)),
+                    )
+            return True
+        if kind == "ds_restore":
+            self.restore_dataset_from_checkpoint(
+                data.get("dataset", ""), data.get("content", "")
+            )
+            return True
+        return False
+
+    def requeue_unacked(self) -> int:
+        """Recovery epilogue: return every un-acked lease to the
+        queues (the dead master's in-flight shards)."""
+        with self._lock:
+            return sum(
+                ds.requeue_unacked() for ds in self._datasets.values()
+            )
 
     # -- timeout reassignment thread --------------------------------------
 

@@ -19,6 +19,8 @@ import argparse
 import os
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -27,6 +29,12 @@ from dlrover_tpu.agent.training import WorkerSpec, launch_agent
 from dlrover_tpu.common.comm import addr_connected, find_free_port
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.journal import JOURNAL_DIR_ENV
+from dlrover_tpu.telemetry.events import emit_event
+
+# how many times tpurun respawns a locally-spawned master that died
+# (each respawn replays the state journal and resumes the job)
+MASTER_MAX_RESTARTS_ENV = "DLROVER_MASTER_MAX_RESTARTS"
 
 
 def parse_nnodes(value: str) -> Tuple[int, int]:
@@ -81,20 +89,30 @@ def parse_args(argv: Optional[List[str]] = None):
     return parser.parse_args(argv)
 
 
-def _launch_local_master(max_nodes: int, port: int = 0) -> Tuple[
-    subprocess.Popen, str
-]:
+def _launch_local_master(
+    max_nodes: int,
+    port: int = 0,
+    journal_dir: str = "",
+    restart_count: int = 0,
+) -> Tuple[subprocess.Popen, str]:
     """Spawn ``python -m dlrover_tpu.master.main`` for single-node /
     test jobs (reference: _launch_dlrover_local_master,
-    elastic_run.py:237)."""
+    elastic_run.py:237).  ``journal_dir`` arms crash recovery: a
+    respawned master pointed at the same directory replays the state
+    journal; ``restart_count`` tells the new incarnation (and its
+    chaos rules) that it IS a respawn."""
     port = port or find_free_port()
+    env = dict(os.environ)
+    if journal_dir:
+        env[JOURNAL_DIR_ENV] = journal_dir
+    env[NodeEnv.RESTART_COUNT] = str(restart_count)
     proc = subprocess.Popen(  # noqa: S603
         [
             sys.executable, "-m", "dlrover_tpu.master.main",
             "--port", str(port),
             "--node_num", str(max_nodes),
         ],
-        env=dict(os.environ),
+        env=env,
     )
     addr = f"127.0.0.1:{port}"
     deadline = time.time() + 30
@@ -106,6 +124,86 @@ def _launch_local_master(max_nodes: int, port: int = 0) -> Tuple[
         time.sleep(0.3)
     proc.kill()
     raise RuntimeError("local master did not become reachable")
+
+
+class _MasterSupervisor:
+    """Watchdog over a locally-spawned master: respawns it on the
+    SAME port with the SAME journal dir when it dies, so the new
+    incarnation replays the journal and every parked client's
+    re-resolve loop finds the master back at the unchanged address.
+    The respawn budget bounds crash loops (a master that dies at
+    replay every time must eventually fail the job)."""
+
+    def __init__(self, proc: subprocess.Popen, addr: str,
+                 max_nodes: int, journal_dir: str):
+        self.proc = proc
+        self.addr = addr
+        self._port = int(addr.rsplit(":", 1)[1])
+        self._max_nodes = max_nodes
+        self._journal_dir = journal_dir
+        self._max_restarts = int(
+            os.environ.get(MASTER_MAX_RESTARTS_ENV, "3") or 3
+        )
+        self.restarts = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch, name="master-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def _watch(self):
+        while not self._stop.wait(0.5):
+            rc = self.proc.poll()
+            if rc is None:
+                continue
+            if self.restarts >= self._max_restarts:
+                logger.error(
+                    "local master died (rc=%s) and the respawn "
+                    "budget (%d) is exhausted; agents will fail "
+                    "their resync windows", rc, self._max_restarts,
+                )
+                return
+            self.restarts += 1
+            logger.warning(
+                "local master died (rc=%s); respawning on port %s "
+                "with journal %s (respawn %d/%d)",
+                rc, self._port, self._journal_dir,
+                self.restarts, self._max_restarts,
+            )
+            emit_event(
+                "master_respawn",
+                port=self._port,
+                respawn=self.restarts,
+                rc=rc,
+            )
+            if self._stop.is_set():
+                # the job is shutting down: a respawn now would leak
+                # a master nobody will ever terminate
+                return
+            try:
+                self.proc, _ = _launch_local_master(
+                    self._max_nodes,
+                    port=self._port,
+                    journal_dir=self._journal_dir,
+                    restart_count=self.restarts,
+                )
+            except RuntimeError as e:
+                logger.error("master respawn failed: %s", e)
+                return
+
+    def shutdown(self):
+        """Stop watching, then terminate whatever incarnation is
+        current (SIGTERM first: the master folds its journal into a
+        final snapshot and emits master_exit).  The join outlasts a
+        worst-case in-flight respawn (startup wait is 30 s) so the
+        terminate below always targets the LIVE incarnation."""
+        self._stop.set()
+        self._thread.join(timeout=35.0)
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
 
 
 def apply_auto_config(args):
@@ -134,15 +232,32 @@ def run(args) -> int:
         else int(os.getenv(NodeEnv.NODE_RANK, "0"))
     )
     master_addr = args.master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")
-    master_proc: Optional[subprocess.Popen] = None
+    supervisor: Optional[_MasterSupervisor] = None
+    journal_dir_created = ""
     if not master_addr:
         if node_rank != 0:
             raise RuntimeError(
                 "--master_addr (or DLROVER_MASTER_ADDR) is required on "
                 "non-zero node ranks"
             )
-        master_proc, master_addr = _launch_local_master(max_nodes)
-        logger.info("launched local master at %s", master_addr)
+        # crash recovery is on by default for the local master: a
+        # fresh per-run journal dir unless the caller pinned one (a
+        # PINNED dir may carry a previous run's state on purpose —
+        # that is the recover-across-tpurun-invocations workflow)
+        journal_dir = os.getenv(JOURNAL_DIR_ENV, "")
+        if not journal_dir:
+            journal_dir = tempfile.mkdtemp(prefix="dlrover_mjournal_")
+            journal_dir_created = journal_dir
+        master_proc, master_addr = _launch_local_master(
+            max_nodes, journal_dir=journal_dir
+        )
+        supervisor = _MasterSupervisor(
+            master_proc, master_addr, max_nodes, journal_dir
+        )
+        logger.info(
+            "launched local master at %s (journal %s)",
+            master_addr, journal_dir,
+        )
 
     # remember the ambient value: when WE spawned the local master its
     # address must not outlive it in this process's env, or the next
@@ -179,18 +294,19 @@ def run(args) -> int:
         return launch_agent(spec, save_ckpt_hook=saver_hook)
     finally:
         AsyncCheckpointSaver.stop_all()
-        if master_proc is not None:
+        if supervisor is not None:
             # the local master dies with this run: restore the env so
             # a later run in this process cannot aim at its corpse
             if prev_master_addr is None:
                 os.environ.pop(NodeEnv.MASTER_ADDR, None)
             else:
                 os.environ[NodeEnv.MASTER_ADDR] = prev_master_addr
-            master_proc.terminate()
-            try:
-                master_proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                master_proc.kill()
+            supervisor.shutdown()
+            if journal_dir_created:
+                # per-run journal: nothing outlives the run it served
+                import shutil
+
+                shutil.rmtree(journal_dir_created, ignore_errors=True)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

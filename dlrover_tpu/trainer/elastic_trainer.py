@@ -198,6 +198,9 @@ class ElasticTrainer:
             "train_step",
             step=self.global_step,
             restart_count=self._restart_count,
+            # which node stepped: multi-agent chaos invariants decide
+            # per-node progress from the event log alone
+            node_rank=env_utils.get_node_rank(),
         )
         # chaos hook AFTER the event: a kill rule at step N must leave
         # step N's completion in the log before the process dies; a

@@ -54,7 +54,11 @@ def pytest_collection_modifyitems(config, items):
     fixtures)."""
     early_files = (
         "test_telemetry.py", "test_chaos.py",
-        "test_restore_pipeline.py",
+        "test_restore_pipeline.py", "test_master_journal.py",
+        # the chaos acceptance e2e runs (worker kill, shm fallback,
+        # master kill/restart) are the recovery regression net — a
+        # truncated window must drop jit heavyweights, not these
+        "test_chaos_e2e.py",
     )
     early = [
         it for it in items

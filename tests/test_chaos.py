@@ -748,3 +748,60 @@ def test_new_scenarios_build_and_select_invariants(tmp_path):
     assert "DLROVER_PREEMPTION_MONITOR" in scenarios.RUN_OPTIONS[
         "ckpt-brownout-during-preemption"
     ]["extra_env"]
+
+
+def test_incarnation_trigger_targets_one_respawn(monkeypatch):
+    """`incarnation: N` fires only in the worker incarnation whose
+    restart count is N — the scheduled-churn scenarios kill
+    incarnation 0 at step A and incarnation 1 at step B without
+    re-killing a respawn that replays step A."""
+    from dlrover_tpu.common.constants import NodeEnv
+
+    spec = {
+        "name": "t", "seed": 0,
+        "rules": [
+            {"point": "trainer.step", "action": "slow",
+             "at_step": 3, "incarnation": 1, "args": {"seconds": 0.0}},
+        ],
+    }
+    monkeypatch.setenv(NodeEnv.RESTART_COUNT, "0")
+    assert _drive_steps(spec).timeline_keys() == []
+    monkeypatch.setenv(NodeEnv.RESTART_COUNT, "1")
+    assert len(_drive_steps(spec).timeline_keys()) == 1
+    monkeypatch.setenv(NodeEnv.RESTART_COUNT, "2")
+    assert _drive_steps(spec).timeline_keys() == []
+
+
+def test_env_equals_targets_process_subset(monkeypatch):
+    """`env_equals` confines a rule to processes whose environment
+    matches — how a partition rule targets ONE node of a multi-agent
+    job or one forkserver template generation."""
+    spec = {
+        "name": "t", "seed": 0,
+        "rules": [
+            {"point": "trainer.step", "action": "slow", "at_step": 2,
+             "env_equals": {"DLROVER_NODE_RANK": "1"},
+             "args": {"seconds": 0.0}},
+        ],
+    }
+    monkeypatch.setenv("DLROVER_NODE_RANK", "0")
+    assert _drive_steps(spec).timeline_keys() == []
+    monkeypatch.setenv("DLROVER_NODE_RANK", "1")
+    assert len(_drive_steps(spec).timeline_keys()) == 1
+
+
+def test_env_equals_and_incarnation_serialize_roundtrip():
+    from dlrover_tpu.chaos.schedule import Scenario
+
+    spec = {
+        "name": "t", "seed": 3,
+        "rules": [
+            {"point": "p", "action": "slow", "at_step": 4,
+             "incarnation": 2,
+             "env_equals": {"DLROVER_NODE_RANK": "1"}},
+        ],
+    }
+    s = Scenario.from_dict(spec)
+    s2 = Scenario.from_dict(s.to_dict())
+    assert s2.rules[0].incarnation == 2
+    assert s2.rules[0].env_equals == {"DLROVER_NODE_RANK": "1"}

@@ -141,6 +141,109 @@ def test_shm_corruption_falls_back_to_storage_tier(tmp_path):
     assert final_step == TOTAL_STEPS and 0 in shards
 
 
+def test_master_kill_restart_midround(tmp_path):
+    """ISSUE 4 acceptance (tier-1): SIGKILL the MASTER on its 3rd
+    shard dispatch mid-rendezvous-round.  tpurun's watchdog respawns
+    it on the same port; the new incarnation replays the state
+    journal, re-enters round 1, re-queues only the un-acked shard,
+    parked clients session-resync — and training completes with NO
+    healthy-worker restart, no duplicate shard completions, none
+    lost.  All decided from telemetry events."""
+    report = _run(
+        tmp_path, scenarios.master_kill_restart_midround(seed=31)
+    )
+    assert report.ok, report.summary()
+    # exactly one seeded master kill, at a shard dispatch
+    assert len(report.timeline) == 1, report.timeline
+    _seq, point, _rule, action, _step = report.timeline[0]
+    assert point == "master.task_dispatch" and action == "kill"
+    # the recovery trail, straight from the events: respawn observed,
+    # journal replayed exactly once, the in-flight lease re-queued
+    respawns = [
+        e for e in report.events if e.get("type") == "master_respawn"
+    ]
+    recoveries = [
+        e for e in report.events
+        if e.get("type") == "master_recovered"
+    ]
+    assert len(respawns) == 1 and len(recoveries) == 1
+    assert recoveries[0]["requeued"] >= 1
+    assert recoveries[0]["rdzv_round"] == 1
+    # the final state on disk is the full run
+    final_step, shards = read_last_checkpoint(
+        str(tmp_path / "run" / "ckpt")
+    )
+    assert final_step == TOTAL_STEPS and 0 in shards
+
+
+@pytest.mark.slow
+def test_multinode_partition_subset_rejoins(tmp_path):
+    """ISSUE 4 satellite: drop RPC for ONE node of a two-agent job
+    (env_equals-targeted partition).  The un-partitioned agent keeps
+    training (never restarted), the partitioned one rides out the
+    window on the reconnect path and rejoins without a full-job
+    restart; both complete their step budget."""
+    report = harness.run_scenario_multinode(
+        scenarios.multinode_rpc_partition(seed=29),
+        workdir=str(tmp_path / "run"),
+        nnodes=2,
+        total_steps=TOTAL_STEPS,
+        faulted_rank=1,
+    )
+    assert report.rc == 0, report.summary()
+    assert all(r.ok for r in report.invariants), report.summary()
+    # the partition really dropped frames, on rank 1 only
+    drops = [t for t in report.timeline if t[3] == "drop"]
+    assert drops, report.timeline
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "factory", ["warm_template_import_kill",
+                "warm_template_midspawn_kill"],
+)
+def test_warm_restart_template_chaos(tmp_path, factory):
+    """ISSUE 4 satellite: kill the forkserver template during its
+    preload imports / mid-spawn — the agent must detect the dead
+    template immediately, fall back to cold spawns
+    (warm_fork_fallback event), finish the job, and leave no orphan
+    processes (template children included)."""
+    report = harness.run_scenario(
+        scenarios.SCENARIOS[factory](),
+        workdir=str(tmp_path / "run"),
+        total_steps=6,
+        ckpt_every=CKPT_EVERY,
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    assert any(
+        t[1].startswith("forkserver.") and t[3] == "kill"
+        for t in report.timeline
+    ), report.timeline
+
+
+@pytest.mark.slow
+def test_goodput_under_scheduled_churn(tmp_path):
+    """ISSUE 4 satellite: bench.py's churn section as a seeded
+    scenario — one SIGKILL per incarnation at fixed absolute steps,
+    warm restarts + per-step flash snapshots keeping recovery short.
+    The master's own accounting (dlrover_goodput_ratio, stamped on
+    master_exit) must stay >= 0.90."""
+    report = harness.run_scenario(
+        scenarios.goodput_under_scheduled_churn(seed=43),
+        workdir=str(tmp_path / "run"),
+        max_restarts=3,
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    kills = [t for t in report.timeline if t[3] == "kill"]
+    assert len(kills) == 2, report.timeline
+    exits = [
+        e for e in report.events if e.get("type") == "master_exit"
+    ]
+    assert exits and float(exits[-1]["goodput"]) >= 0.90, exits
+
+
 @pytest.mark.slow
 def test_ckpt_brownout_during_preemption(tmp_path):
     """ROADMAP scenario: storage browns out exactly while the

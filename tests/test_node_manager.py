@@ -553,3 +553,77 @@ def test_advance_notice_launches_replacement_without_killing_pod(k8s):
         assert mgr.job_exit_reason == ""
     finally:
         mgr.stop()
+
+
+def test_terminal_decision_survives_master_restart(k8s, tmp_path):
+    """ISSUE 4 satellite (extends the PR-3 node_manager fix across
+    the restart boundary): the first master declines a FATAL_ERROR
+    relaunch and journals that terminal decision; a respawned master
+    restores it, and a LATE preemption_notice or node-exit report
+    referencing the pre-restart incarnation must neither overwrite
+    the journaled exit reason nor resurrect the node as relaunchable
+    PREEMPTED."""
+    from dlrover_tpu.master.journal import StateJournal, replay_dir
+    from dlrover_tpu.master.recovery import restore_master
+
+    client, api = k8s
+    mgr = _manager(client)
+    mgr.journal = StateJournal(str(tmp_path / "j"))
+    mgr.start()
+    try:
+        assert _wait_until(lambda: len(api.pods) == 2)
+        api.set_pod_phase("tj-worker-1", "Running")
+        # fatal code error: relaunch declined, decision journaled
+        api.set_pod_phase("tj-worker-1", "Failed", exit_code=1)
+        assert _wait_until(
+            lambda: mgr.get_node(1) is not None
+            and mgr.get_node(1).status == NodeStatus.FAILED
+        )
+        time.sleep(0.3)
+        assert "tj-worker-2" not in api.pods
+        assert 1 in mgr._terminal_decisions
+    finally:
+        mgr.stop()
+        mgr.journal.close()
+
+    # ---- master restart: a fresh manager restores the journal
+    mgr2 = _manager(client)
+    replayed = replay_dir(str(tmp_path / "j"))
+
+    class _Shim:
+        """restore_master targets a JobMaster; give it just the
+        sub-managers this test restores."""
+        task_manager = type(
+            "T", (), {
+                "restore_state": staticmethod(lambda s: None),
+                "apply_journal_entry":
+                    staticmethod(lambda k, d: False),
+                "requeue_unacked": staticmethod(lambda: 0),
+            },
+        )()
+        rdzv_managers = {}
+        job_manager = mgr2
+        kv_store = type(
+            "K", (), {"load": staticmethod(lambda d: None)}
+        )()
+        recoveries = 0
+
+    restore_master(_Shim, replayed)
+    node = mgr2.get_node(1)
+    assert node is not None
+    assert 1 in mgr2._terminal_decisions
+    exit_reason_before = node.exit_reason
+
+    # late ADVANCE notice from the dead incarnation: must NOT turn
+    # the declined FATAL_ERROR into a relaunchable PREEMPTED
+    mgr2.handle_preemption_notice(1, NodeType.WORKER)
+    assert node.exit_reason == exit_reason_before
+    assert not [p for p in api.pods if p == "tj-worker-2"]
+
+    # late exit report from the dead incarnation: terminal decision
+    # stands, no transition fires
+    assert mgr2.update_node_status(
+        1, NodeType.WORKER, NodeStatus.DELETED,
+        exit_reason=NodeExitReason.PREEMPTED,
+    ) is False
+    assert node.exit_reason == exit_reason_before
