@@ -54,6 +54,7 @@ from dlrover_tpu.telemetry.exporter import (
     TextfileDumper,
 )
 from dlrover_tpu.telemetry.metrics import get_registry
+from dlrover_tpu.telemetry.otlp import maybe_from_env as otlp_from_env
 
 _REG = get_registry()
 _RDZV_SECONDS = _REG.histogram(
@@ -537,6 +538,11 @@ class ElasticTrainingAgent:
         dumper = TextfileDumper(textfile) if textfile else None
         if dumper is not None:
             dumper.start()
+        # push the agent's spans/metrics to an OTLP collector when
+        # configured (agents have no stable scrape address under churn)
+        otlp = otlp_from_env(service_name="dlrover_tpu.agent")
+        if otlp is not None:
+            otlp.start()
         for m in self._monitors:
             m.start()
         try:
@@ -546,6 +552,8 @@ class ElasticTrainingAgent:
                 m.stop()
             if dumper is not None:
                 dumper.stop()
+            if otlp is not None:
+                otlp.stop()
             if self._forkserver is not None:
                 self._forkserver.close()
 

@@ -19,10 +19,58 @@ Runnable standalone::
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
 from dlrover_tpu.brain.service import JobMetricRecord
 from dlrover_tpu.common.log import default_logger as logger
+
+
+def record_goodput_attribution(
+    store, job_name: str, attribution: Dict,
+    timestamp: Optional[float] = None,
+) -> None:
+    """Persist a flight-recorder goodput-loss diagnosis
+    (:func:`dlrover_tpu.telemetry.timeline.attribute_goodput_loss`)
+    into the Brain datastore — the diagnosis layer learns from the
+    SAME numbers the operator's /timeline report shows, instead of
+    re-deriving its own.  One row per attribution pass, cause buckets
+    in the extra columns."""
+    buckets = dict(attribution.get("buckets") or {})
+    store.persist(
+        JobMetricRecord(
+            job_name=job_name,
+            timestamp=timestamp or time.time(),
+            finished=False,
+        ),
+        event="goodput_attribution",
+        goodput=attribution.get("goodput"),
+        window_s=attribution.get("window_s"),
+        training_s=attribution.get("training_s"),
+        loss_s=attribution.get("loss_s"),
+        **{f"loss_{cause}_s": v for cause, v in buckets.items()},
+    )
+
+
+def ingest_job_events(
+    store, job_name: str, sources: Iterable[str]
+) -> Optional[Dict]:
+    """Assemble a job's shipped event logs and persist the resulting
+    goodput diagnosis; returns the attribution (None when the logs
+    hold no training window)."""
+    from dlrover_tpu.telemetry import timeline as _timeline
+
+    events = _timeline.collect_events(sources)
+    if not events:
+        return None
+    tl = _timeline.assemble(events)
+    if tl.window is None:
+        # lifecycle events but no train_step: the job never trained,
+        # so there is no goodput to attribute — persisting the zeroed
+        # default would record a failed job as goodput=1.0
+        return None
+    attribution = _timeline.attribute_goodput_loss(tl)
+    record_goodput_attribution(store, job_name, attribution)
+    return attribution
 
 
 @dataclass

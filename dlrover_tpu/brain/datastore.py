@@ -88,6 +88,32 @@ class SqliteJobMetricsStore:
             for r in rows
         ]
 
+    def load_extras(
+        self, job_name: Optional[str] = None
+    ) -> List[dict]:
+        """The tagged extra columns (lifecycle events, goodput
+        attributions) as dicts with their row timestamp — what the
+        Brain's diagnosis consumers read back."""
+        query = (
+            "SELECT job_name, timestamp, extra FROM job_metrics "
+            "WHERE extra != ''"
+        )
+        args: tuple = ()
+        if job_name is not None:
+            query += " AND job_name = ?"
+            args = (job_name,)
+        with self._lock:
+            rows = self._conn.execute(query, args).fetchall()
+        out = []
+        for job, ts, extra in rows:
+            try:
+                doc = json.loads(extra)
+            except (TypeError, ValueError):
+                continue
+            doc.update(job_name=job, timestamp=ts)
+            out.append(doc)
+        return out
+
     def job_names(self) -> List[str]:
         with self._lock:
             rows = self._conn.execute(

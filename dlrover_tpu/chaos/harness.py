@@ -46,7 +46,12 @@ from dlrover_tpu.chaos.scenarios import (
 from dlrover_tpu.chaos.schedule import Scenario, load_scenario
 from dlrover_tpu.common.env_utils import proc_stat_fields
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.telemetry.events import EVENT_LOG_ENV, read_events
+from dlrover_tpu.telemetry import timeline as flight
+from dlrover_tpu.telemetry.events import (
+    EVENT_LOG_ENV,
+    EVENTS_AGGREGATE_ENV,
+    collect_events,
+)
 
 CHAOS_EVENT = "chaos_inject"
 
@@ -519,6 +524,59 @@ class GoodputAtLeast(Invariant):
         )
 
 
+class GoodputLossAttributed(Invariant):
+    """Flight-recorder invariant: the assembled timeline's
+    goodput-loss diagnosis must attribute at least
+    ``min_attributed_frac`` of the measured non-training wall-clock
+    to NAMED causes (rendezvous / restore / master recovery /
+    straggler) — an unattributed majority means the telemetry lost
+    the causal trail.  Reads the ready-made ``run.attribution``
+    instead of re-parsing raw events; runs with no measurable loss
+    pass vacuously."""
+
+    name = "goodput_loss_attributed"
+
+    def __init__(self, min_attributed_frac: float = 0.5,
+                 expect_cause: str = ""):
+        self.min_attributed_frac = min_attributed_frac
+        self.expect_cause = expect_cause
+
+    def check(self, events, run):
+        attr = run.attribution
+        if attr is None:
+            tl = flight.assemble(events)
+            attr = flight.attribute_goodput_loss(tl)
+        loss = attr["loss_s"]
+        if loss <= 0:
+            return InvariantResult(
+                self.name, True, "no non-training time to attribute"
+            )
+        named = sum(
+            v for k, v in attr["buckets"].items()
+            if k != flight.CAUSE_UNATTRIBUTED
+        )
+        frac = named / loss
+        if self.expect_cause and (
+            attr["buckets"].get(self.expect_cause, 0.0) <= 0
+        ):
+            return InvariantResult(
+                self.name, False,
+                f"expected cause {self.expect_cause!r} got 0s "
+                f"(buckets: {attr['buckets']})",
+            )
+        if frac < self.min_attributed_frac:
+            return InvariantResult(
+                self.name, False,
+                f"only {frac:.0%} of {loss:.3f}s lost attributed "
+                f"(buckets: {attr['buckets']})",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{frac:.0%} of {loss:.3f}s lost attributed "
+            f"({ {k: round(v, 3) for k, v in attr['buckets'].items()} })",
+        )
+
+
 class NodeCompletedSteps(Invariant):
     """Per-node progress in a multi-agent run: node ``rank`` stepped
     through at least ``total_steps`` (train_step events carry
@@ -722,6 +780,11 @@ class ChaosRunReport:
     events: List[dict] = field(default_factory=list)
     timeline: List[Tuple] = field(default_factory=list)
     invariants: List[InvariantResult] = field(default_factory=list)
+    # flight recorder: the assembled job timeline + goodput-loss
+    # attribution, ready-made for invariants and post-mortems (no
+    # re-parsing of raw events)
+    job_timeline: Optional[flight.JobTimeline] = None
+    attribution: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -735,6 +798,12 @@ class ChaosRunReport:
         ]
         for t in self.timeline:
             lines.append(f"  inject {t}")
+        if self.attribution and self.attribution["loss_s"] > 0:
+            lines.append(
+                f"  goodput {self.attribution['goodput']:.4f}  "
+                f"lost {self.attribution['loss_s']:.3f}s "
+                f"{self.attribution['buckets']}"
+            )
         for r in self.invariants:
             mark = "PASS" if r.ok else "FAIL"
             lines.append(f"  [{mark}] {r.name}: {r.detail}")
@@ -763,6 +832,36 @@ class _patched_env:
             else:
                 os.environ[k] = old
         return False
+
+
+def _build_report(
+    scenario, rc: int, workdir: str, event_log: str,
+    extra_sources: Optional[List[str]] = None,
+) -> ChaosRunReport:
+    """Collect the run's event stream (master log + any agent-shipped
+    logs), assemble the flight-recorder timeline and goodput-loss
+    attribution, and wrap everything in a report — the single
+    post-run ingestion path both harness flavours share."""
+    sources = [event_log] + list(extra_sources or [])
+    events = collect_events(sources)
+    report = ChaosRunReport(
+        scenario=scenario.name,
+        seed=scenario.seed,
+        rc=rc,
+        workdir=workdir,
+        event_log=event_log,
+        events=events,
+        timeline=timeline_from_events(events),
+    )
+    try:
+        report.job_timeline = flight.assemble(events)
+        report.attribution = flight.attribute_goodput_loss(
+            report.job_timeline
+        )
+    except Exception:  # noqa: BLE001 - assembly bug must not hide
+        # the raw events from the invariants
+        logger.exception("flight-recorder assembly failed")
+    return report
 
 
 def default_invariants(
@@ -794,12 +893,18 @@ def invariants_for_scenario(
 ) -> List[Invariant]:
     if name == "master-kill-restart-midround":
         # the control-plane recovery trail: journal replay, client
-        # resyncs, exactly-once sharding, NO data-plane restarts
+        # resyncs, exactly-once sharding, NO data-plane restarts —
+        # and the flight recorder must attribute the outage to
+        # master recovery
         return [
             MasterRecovered(),
             HealthyWorkersNotRestarted(),
             NoDuplicateShards(dataset_size=total_steps),
             FinalStepCommitted(),
+            GoodputLossAttributed(
+                min_attributed_frac=0.5,
+                expect_cause=flight.CAUSE_MASTER_RECOVERY,
+            ),
             NoOrphanProcesses(marker=workdir),
         ]
     if name in ("warm-template-import-kill",
@@ -926,18 +1031,7 @@ def run_scenario(
         finally:
             _chaos.uninstall()
 
-    events = list(read_events(event_log)) if os.path.exists(
-        event_log
-    ) else []
-    report = ChaosRunReport(
-        scenario=scenario.name,
-        seed=scenario.seed,
-        rc=rc,
-        workdir=workdir,
-        event_log=event_log,
-        events=events,
-        timeline=timeline_from_events(events),
-    )
+    report = _build_report(scenario, rc, workdir, event_log)
     checks = (
         invariants if invariants is not None
         else invariants_for_scenario(
@@ -947,7 +1041,9 @@ def run_scenario(
     )
     for inv in checks:
         try:
-            report.invariants.append(inv.check(events, report))
+            report.invariants.append(
+                inv.check(report.events, report)
+            )
         except Exception as e:  # noqa: BLE001 - a checker bug is a FAIL
             logger.exception("invariant %s crashed", inv.name)
             report.invariants.append(
@@ -1015,7 +1111,13 @@ def run_scenario_multinode(
     script = os.path.join(workdir, "chaos_train.py")
     with open(script, "w") as f:
         f.write(CHAOS_TRAIN_SCRIPT)
+    # event shipping, the deployment shape: the master writes its own
+    # log; every agent (and the trainers it spawns) writes a per-node
+    # log, and the aggregate glob folds them into the master's
+    # /timeline + the post-run assembly — the event analog of the
+    # DLROVER_METRICS_AGGREGATE_GLOB textfile aggregation
     event_log = os.path.join(workdir, "events.jsonl")
+    agent_event_glob = os.path.join(workdir, "events_node*.jsonl")
 
     base_env = dict(
         os.environ,
@@ -1023,6 +1125,7 @@ def run_scenario_multinode(
         **{
             _chaos.CHAOS_ENV: spec_path,
             EVENT_LOG_ENV: event_log,
+            EVENTS_AGGREGATE_ENV: agent_event_glob,
             TOTAL_STEPS_ENV: str(total_steps),
             CKPT_EVERY_ENV: str(ckpt_every),
         },
@@ -1075,6 +1178,9 @@ def run_scenario_multinode(
             env = dict(
                 base_env,
                 DLROVER_MASTER_ADDR=addr,
+                **{EVENT_LOG_ENV: os.path.join(
+                    workdir, f"events_node{rank}.jsonl"
+                )},
                 DLROVER_NODE_RANK=str(rank),
                 DLROVER_NODE_ID=str(rank),
                 DLROVER_SHARED_DIR=os.path.join(
@@ -1128,17 +1234,9 @@ def run_scenario_multinode(
             except OSError:
                 pass
 
-    events = list(read_events(event_log)) if os.path.exists(
-        event_log
-    ) else []
-    report = ChaosRunReport(
-        scenario=scenario.name,
-        seed=scenario.seed,
-        rc=rc,
-        workdir=workdir,
-        event_log=event_log,
-        events=events,
-        timeline=timeline_from_events(events),
+    report = _build_report(
+        scenario, rc, workdir, event_log,
+        extra_sources=[agent_event_glob],
     )
     checks = (
         invariants if invariants is not None
@@ -1148,7 +1246,9 @@ def run_scenario_multinode(
     )
     for inv in checks:
         try:
-            report.invariants.append(inv.check(events, report))
+            report.invariants.append(
+                inv.check(report.events, report)
+            )
         except Exception as e:  # noqa: BLE001 - a checker bug is a FAIL
             logger.exception("invariant %s crashed", inv.name)
             report.invariants.append(

@@ -43,6 +43,7 @@ from dlrover_tpu.telemetry.exporter import (
     PrometheusEndpoint,
 )
 from dlrover_tpu.telemetry.metrics import get_registry
+from dlrover_tpu.telemetry.otlp import maybe_from_env as otlp_from_env
 
 _RECOVERIES_TOTAL = get_registry().counter(
     "dlrover_master_recoveries_total",
@@ -143,6 +144,13 @@ class JobMaster:
             self.servicer.journal = self.journal
             for mngr in self.rdzv_managers.values():
                 mngr.on_round_complete = self._journal_rdzv_round
+            # check RESULTS are journaled too, not just membership —
+            # a mid-check master crash must not lose reports that
+            # already arrived (ROADMAP master fault-tolerance
+            # follow-on)
+            self.network_rdzv.on_status_report = (
+                self._journal_netcheck_status
+            )
             self._snapshot_journal()
         self.servicer.incarnation = self.incarnation
         self.servicer.recoveries = self.recoveries
@@ -170,6 +178,12 @@ class JobMaster:
                     "invalid %s=%r; metrics endpoint disabled",
                     METRICS_PORT_ENV, metrics_port,
                 )
+        # OTLP push export (spans + metrics) to a collector when
+        # DLROVER_OTLP_ENDPOINT is set — same aux-service lifecycle
+        # as the scrape endpoint, zero instrumentation-site changes
+        otlp = otlp_from_env(service_name="dlrover_tpu.master")
+        if otlp is not None:
+            self.aux_services.append(otlp)
         self._stop = threading.Event()
         self._exit_code = 0
         self._run_thread: Optional[threading.Thread] = None
@@ -191,6 +205,20 @@ class JobMaster:
                     "name": name,
                     "round": round_,
                     "participants": participants,
+                },
+            )
+
+    def _journal_netcheck_status(
+        self, node_id, normal, elapsed, round_
+    ):
+        if self.journal is not None:
+            self.journal.append(
+                "netcheck_status",
+                {
+                    "node_id": node_id,
+                    "normal": normal,
+                    "elapsed": elapsed,
+                    "round": round_,
                 },
             )
 

@@ -342,6 +342,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._node_times: Dict[int, Dict[int, float]] = {}
         self._check_round = 0
         self._groups: List[List[int]] = []
+        # master crash recovery (ROADMAP follow-on): called with each
+        # reported (node_id, normal, elapsed, round) so the state
+        # journal records check RESULTS, not just round membership —
+        # a master crash mid-check no longer loses the reports that
+        # already arrived, so fault confirmation ("abnormal in two
+        # consecutive rounds") survives the restart
+        self.on_status_report = None
 
     def _group_nodes(self, ranks: List[int]) -> List[List[int]]:
         """Round 0: neighbour pairs; round >0: sorted by previous
@@ -418,6 +425,94 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             rnd = max(self._check_round - 1, 0)
             self._node_status.setdefault(rnd, {})[node_id] = normal
             self._node_times.setdefault(rnd, {})[node_id] = elapsed
+        if self.on_status_report is not None:
+            try:  # journal OUTSIDE the lock: fsync under it would
+                # serialize every concurrent report on disk latency
+                self.on_status_report(node_id, bool(normal),
+                                      float(elapsed), rnd)
+            except Exception:  # noqa: BLE001 - journal must not kill
+                logger.exception("netcheck journal hook failed")
+
+    def restore_status(
+        self, round_: int, node_id: int, normal: bool, elapsed: float
+    ):
+        """Journal replay: re-apply one reported check result at the
+        round it was recorded for (idempotent — same record twice
+        lands on the same cell)."""
+        with self._lock:
+            rnd = int(round_)
+            self._node_status.setdefault(rnd, {})[int(node_id)] = bool(
+                normal
+            )
+            self._node_times.setdefault(rnd, {})[int(node_id)] = float(
+                elapsed
+            )
+            self._check_round = max(self._check_round, rnd + 1)
+
+    def journal_state(self) -> Dict:
+        """Round membership PLUS the check state (statuses, elapsed
+        times, grouping, check round) for the journal snapshot."""
+        out = super().journal_state()
+        with self._lock:
+            out["check"] = {
+                "check_round": self._check_round,
+                "groups": [list(g) for g in self._groups],
+                "node_status": {
+                    str(rnd): {str(n): ok for n, ok in st.items()}
+                    for rnd, st in self._node_status.items()
+                },
+                "node_times": {
+                    str(rnd): {str(n): t for n, t in tm.items()}
+                    for rnd, tm in self._node_times.items()
+                },
+            }
+        return out
+
+    def restore_round(self, round_: int, participants: Dict) -> None:
+        """A journaled network-check round also restores its pairwise
+        grouping so re-joined agents polling ``get_comm_world`` see
+        the same groups, and the check-round counter advances."""
+        super().restore_round(round_, participants)
+        with self._lock:
+            if int(round_) > 0 and self._check_round < int(round_):
+                # mirror the live completion ordering exactly:
+                # get_comm_world builds groups BEFORE bumping
+                # _check_round, so round R's grouping reads round
+                # R-2's elapsed times (replayed from the
+                # netcheck_status records that precede this round
+                # record in the journal).  Grouping after the bump
+                # would read the not-yet-replayed round R-1 and fall
+                # back to neighbour pairs — diverging from what the
+                # pre-crash agents were already paired as.
+                self._check_round = int(round_) - 1
+                self._groups = self._group_nodes(
+                    sorted(self._rdzv_nodes.keys())
+                )
+                self._check_round = int(round_)
+
+    def restore_check_state(self, state: Dict) -> None:
+        """Snapshot replay epilogue: load the full check state the
+        snapshot captured (overrides what the round record derived)."""
+        check = (state or {}).get("check") or {}
+        if not check:
+            return
+        with self._lock:
+            self._check_round = max(
+                self._check_round, int(check.get("check_round", 0))
+            )
+            groups = check.get("groups") or []
+            if groups:
+                self._groups = [
+                    [int(r) for r in group] for group in groups
+                ]
+            for rnd_s, st in (check.get("node_status") or {}).items():
+                dst = self._node_status.setdefault(int(rnd_s), {})
+                for node_s, ok in st.items():
+                    dst[int(node_s)] = bool(ok)
+            for rnd_s, tm in (check.get("node_times") or {}).items():
+                dst = self._node_times.setdefault(int(rnd_s), {})
+                for node_s, t in tm.items():
+                    dst[int(node_s)] = float(t)
 
     def check_fault_node(self) -> Tuple[List[int], str]:
         """Fault = abnormal in the latest round AND in the previous

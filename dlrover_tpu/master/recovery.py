@@ -57,6 +57,10 @@ def restore_master(master, replayed: JournalReplay) -> Dict[str, int]:
                     state.get("round", 0),
                     state.get("participants") or {},
                 )
+                # network-check flavour: statuses/elapsed/grouping
+                # from the snapshot, not just membership
+                if hasattr(mngr, "restore_check_state"):
+                    mngr.restore_check_state(state)
     applied = 0
     for _seq, kind, data in replayed.entries:
         try:
@@ -73,6 +77,15 @@ def restore_master(master, replayed: JournalReplay) -> Dict[str, int]:
                         data.get("round", 0),
                         data.get("participants") or {},
                     )
+                applied += 1
+                continue
+            if kind == "netcheck_status":
+                master.network_rdzv.restore_status(
+                    data.get("round", 0),
+                    data.get("node_id", 0),
+                    data.get("normal", True),
+                    data.get("elapsed", 0.0),
+                )
                 applied += 1
                 continue
             if kind == "kv_set":

@@ -17,14 +17,28 @@ hard dependencies:
   event log (schema-versioned, size-rotated), shared by master, agent
   and trainer processes through ``DLROVER_EVENT_LOG``.
 - :mod:`dlrover_tpu.telemetry.exporter` — a Prometheus scrape
-  endpoint served from the master plus a textfile dump fallback for
-  agents.
+  endpoint served from the master (plus ``/timeline``, the job
+  flight-recorder view) and a textfile dump fallback for agents.
+- :mod:`dlrover_tpu.telemetry.otlp` — OTLP/HTTP JSON push export of
+  spans and metrics to an OpenTelemetry collector
+  (``DLROVER_OTLP_ENDPOINT``), behind the same registry/tracer
+  interfaces.
+- :mod:`dlrover_tpu.telemetry.timeline` — job timeline assembly from
+  the per-process event logs (Chrome trace JSON, incident report,
+  goodput-loss attribution); runnable as
+  ``python -m dlrover_tpu.telemetry.timeline``.
+- :mod:`dlrover_tpu.telemetry.schema` +
+  :mod:`dlrover_tpu.telemetry.check_events` — the event-schema
+  registry and its call-site/log checker
+  (``python -m dlrover_tpu.telemetry.check_events``).
 """
 
 from dlrover_tpu.telemetry.events import (
     EVENT_SCHEMA_VERSION,
     TrainingEventExporter,
+    collect_events,
     emit_event,
+    read_events,
     set_event_source,
 )
 from dlrover_tpu.telemetry.exporter import (
@@ -61,7 +75,9 @@ __all__ = [
     "span",
     "EVENT_SCHEMA_VERSION",
     "TrainingEventExporter",
+    "collect_events",
     "emit_event",
+    "read_events",
     "set_event_source",
     "PrometheusEndpoint",
     "TextfileDumper",

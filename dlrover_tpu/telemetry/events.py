@@ -17,11 +17,12 @@ mode, so concurrent processes interleave whole lines; rotation renames
 the file to ``<path>.1`` when it exceeds ``max_bytes``.
 """
 
+import glob as _glob
 import json
 import os
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterable, Iterator, List, Optional
 
 try:
     import fcntl
@@ -32,6 +33,12 @@ EVENT_SCHEMA_VERSION = 1
 EVENT_LOG_ENV = "DLROVER_EVENT_LOG"
 EVENT_LOG_MAX_BYTES_ENV = "DLROVER_EVENT_LOG_MAX_BYTES"
 EVENT_SOURCE_ENV = "DLROVER_EVENT_SOURCE"
+# agents ship their event logs the same way textfile metric dumps ride
+# DLROVER_METRICS_AGGREGATE_GLOB: each agent writes its own JSONL
+# (DLROVER_EVENT_LOG pointing at a per-node file on shared storage)
+# and the master's /timeline endpoint + the timeline CLI fold every
+# file matching this glob into one causally-ordered job view
+EVENTS_AGGREGATE_ENV = "DLROVER_EVENTS_AGGREGATE_GLOB"
 DEFAULT_MAX_BYTES = 64 * 1024 * 1024
 
 
@@ -150,17 +157,72 @@ class TrainingEventExporter:
 
 
 def read_events(path: str) -> Iterator[Dict]:
-    """Parse a JSONL event log, skipping torn/partial lines (a
-    concurrent writer may be mid-line at read time)."""
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
+    """Parse a JSONL event log, skipping torn/partial lines instead of
+    raising — mirroring the master journal's prefix-consistent replay.
+
+    A process killed mid-write (every chaos kill scenario) can leave a
+    truncated trailing line, possibly cut inside a multi-byte UTF-8
+    sequence or containing garbage bytes; a concurrent writer may be
+    mid-line at read time.  The file is therefore streamed as BYTES
+    and each line decoded independently: a line that fails to decode
+    or to parse (the torn tail is just the final partial line) is
+    dropped, never an exception into the consumer (timeline assembly,
+    chaos invariants, the /timeline endpoint)."""
+    with open(path, "rb") as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
                 continue
             try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
+                record = json.loads(raw.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError):
                 continue
+            if isinstance(record, dict):
+                yield record
+
+
+def _with_backups(path: str) -> List[str]:
+    """One event log plus its rotated history (``<path>.N`` …
+    ``<path>.1``), oldest first: rotation renames the live file away,
+    so assembly that reads only ``path`` silently loses a long job's
+    early hours."""
+    backups: List[str] = []
+    i = 1
+    while i <= 64 and os.path.exists(f"{path}.{i}"):
+        backups.append(f"{path}.{i}")
+        i += 1
+    return backups[::-1] + [path]
+
+
+def collect_events(sources: Iterable[str]) -> List[Dict]:
+    """Merge event logs from ``sources`` (file paths and/or glob
+    patterns, each folded with its rotated backups) into one stream
+    ordered by emission timestamp — the ingestion step of timeline
+    assembly.  Missing files are skipped; records without a numeric
+    ``ts`` sort first (schema guards upstream make them rare)."""
+    merged: List[Dict] = []
+    seen: set = set()
+    for src in sources:
+        if not src:
+            continue
+        paths = (
+            sorted(_glob.glob(src)) if _glob.has_magic(src) else [src]
+        )
+        for base in paths:
+            for path in _with_backups(base):
+                real = os.path.realpath(path)
+                if real in seen:  # a glob overlapping an explicit path
+                    continue
+                seen.add(real)
+                try:
+                    merged.extend(read_events(path))
+                except OSError:
+                    continue
+    def _ts(e: Dict) -> float:
+        ts = e.get("ts")
+        return ts if isinstance(ts, (int, float)) else 0.0
+    merged.sort(key=_ts)
+    return merged
 
 
 _default_exporter: Optional[TrainingEventExporter] = None

@@ -18,6 +18,7 @@
 """
 
 import glob as _glob
+import json as _json
 import os
 import threading
 from collections import OrderedDict
@@ -173,7 +174,11 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         registry: _metrics.MetricsRegistry = (
             self.server.registry  # type: ignore[attr-defined]
         )
-        if self.path.split("?")[0] not in ("/metrics", "/"):
+        path = self.path.split("?")[0]
+        if path == "/timeline":
+            self._serve_timeline()
+            return
+        if path not in ("/metrics", "/"):
             self.send_error(404)
             return
         text = registry.render_prometheus()
@@ -197,6 +202,42 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _serve_timeline(self):
+        """GET /timeline: the assembled job flight recorder — Chrome
+        trace-event JSON by default (loadable in Perfetto),
+        ``?format=report`` for the plain-text incident report.
+        Sources: the endpoint's configured event files plus the
+        agent-shipping glob (``DLROVER_EVENTS_AGGREGATE_GLOB``), the
+        event analog of the metrics textfile aggregation."""
+        from dlrover_tpu.telemetry import timeline as _timeline
+
+        try:
+            sources = (
+                list(getattr(self.server, "event_sources", None) or [])
+                or _timeline.default_sources()
+            )
+            events = _timeline.collect_events(sources)
+            tl = _timeline.assemble(events)
+            attribution = _timeline.attribute_goodput_loss(tl)
+            if "format=report" in (self.path.split("?", 1) + [""])[1]:
+                body = _timeline.to_report(tl, attribution).encode()
+                ctype = "text/plain; charset=utf-8"
+            else:
+                body = _json.dumps(
+                    _timeline.to_chrome_trace(tl, attribution),
+                    default=str,
+                ).encode()
+                ctype = "application/json"
+        except Exception as e:  # noqa: BLE001 - never fail the server
+            logger.warning("timeline assembly failed: %s", e)
+            self.send_error(500, "timeline assembly failed")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, fmt, *args):  # silence per-scrape stderr
         pass
 
@@ -211,15 +252,20 @@ class PrometheusEndpoint:
         host: str = "0.0.0.0",
         registry: Optional[_metrics.MetricsRegistry] = None,
         aggregate_glob: str = "",
+        event_sources: Optional[List[str]] = None,
     ):
         """``aggregate_glob``: glob of agent textfile dumps folded
         into every scrape response (one master scrape covers
         worker-side metrics); defaults to
-        ``DLROVER_METRICS_AGGREGATE_GLOB`` at request time."""
+        ``DLROVER_METRICS_AGGREGATE_GLOB`` at request time.
+        ``event_sources``: event-log paths/globs ``/timeline``
+        assembles from; defaults to ``DLROVER_EVENT_LOG`` +
+        ``DLROVER_EVENTS_AGGREGATE_GLOB`` at request time."""
         self._requested_port = port
         self._host = host
         self._registry = registry or _metrics.get_registry()
         self._aggregate_glob = aggregate_glob
+        self._event_sources = event_sources
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port = 0
@@ -246,6 +292,9 @@ class PrometheusEndpoint:
         self._server.registry = self._registry  # type: ignore[attr-defined]
         self._server.aggregate_glob = (  # type: ignore[attr-defined]
             self._aggregate_glob
+        )
+        self._server.event_sources = (  # type: ignore[attr-defined]
+            self._event_sources
         )
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(

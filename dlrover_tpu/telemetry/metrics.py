@@ -87,6 +87,21 @@ class Metric:
         """Yield (sample name, rendered labels, value)."""
         raise NotImplementedError
 
+    def collect(self) -> List[Tuple[Dict[str, str], object]]:
+        """Structured series view for push exporters (OTLP): one
+        ``(labels, value)`` pair per label combination.  Counters and
+        gauges yield floats; histograms yield
+        ``{"count", "sum", "bounds", "bucket_counts"}`` (per-bucket,
+        non-cumulative, last bucket is +Inf)."""
+        with self._lock:
+            return [
+                (dict(key), self._collect_value(series))
+                for key, series in sorted(self._series.items())
+            ]
+
+    def _collect_value(self, series):
+        return float(series)
+
     def render(self) -> str:
         lines = []
         if self.help:
@@ -306,6 +321,15 @@ class Histogram(Metric):
                 )
             yield self.name + "_sum", _render_labels(key), series.total
             yield self.name + "_count", _render_labels(key), series.count
+
+    def _collect_value(self, series: _HistogramSeries):
+        return {
+            "count": series.count,
+            "sum": series.total,
+            # finite upper bounds; counts carry one extra (+Inf) entry
+            "bounds": [b for b in self.buckets if b != math.inf],
+            "bucket_counts": list(series.counts),
+        }
 
 
 class MetricsRegistry:

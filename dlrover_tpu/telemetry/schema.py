@@ -1,0 +1,176 @@
+"""Registry of every known training-event type and its fields.
+
+Four PRs of instrumentation made the JSONL event log the substrate
+that chaos invariants, the timeline assembler and the goodput
+diagnosis all decide from — which means a silently forked schema
+(a renamed field, an unregistered type) breaks *verification*, not
+just dashboards.  This module is the single source of truth:
+
+- :data:`EVENT_SCHEMAS` lists every event type with its required and
+  optional fields;
+- :func:`validate_event` checks one recorded event dict;
+- :func:`validate_call` checks one ``emit_event`` call site (the AST
+  scanner in :mod:`dlrover_tpu.telemetry.check_events` feeds it).
+
+New instrumentation MUST register its event type here; the tier-1
+schema checker fails otherwise.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Sequence
+
+# envelope stamped by TrainingEventExporter.emit on every record
+COMMON_FIELDS: FrozenSet[str] = frozenset(
+    {"schema", "ts", "pid", "source", "type"}
+)
+
+
+@dataclass(frozen=True)
+class EventSchema:
+    type: str
+    required: FrozenSet[str]
+    optional: FrozenSet[str] = frozenset()
+    # events whose payload is an open phase/stat dict (e.g. the
+    # checkpoint engine's per-stage timings) accept extra fields
+    allow_extra: bool = False
+
+    @property
+    def known(self) -> FrozenSet[str]:
+        return self.required | self.optional | COMMON_FIELDS
+
+
+def _s(
+    type_: str,
+    required: Sequence[str],
+    optional: Sequence[str] = (),
+    allow_extra: bool = False,
+) -> EventSchema:
+    return EventSchema(
+        type_, frozenset(required), frozenset(optional), allow_extra
+    )
+
+
+EVENT_SCHEMAS: Dict[str, EventSchema] = {
+    s.type: s
+    for s in (
+        # -- telemetry core ------------------------------------------
+        _s("span", [
+            "name", "trace_id", "span_id", "parent_id",
+            "duration_s", "status", "attributes",
+        ]),
+        # -- master lifecycle ----------------------------------------
+        _s("master_start", ["job", "port", "node_num", "metrics_port"]),
+        _s("master_exit", [
+            "job", "rc", "exit_reason", "global_step", "goodput",
+            "recoveries",
+        ]),
+        _s("master_recovered",
+           ["job", "incarnation", "recoveries", "rdzv_round"],
+           ["entries", "applied", "requeued", "snapshot", "truncated"]),
+        _s("master_respawn", ["port", "respawn", "rc"]),
+        _s("journal_replay", [
+            "dir", "entries", "snapshot_seq", "last_seq", "truncated",
+        ]),
+        # -- rendezvous / sharding -----------------------------------
+        _s("rendezvous_complete", ["rdzv", "round", "nodes", "wait_s"]),
+        _s("shard_dispatch",
+           ["dataset", "task_id", "worker", "start", "end"]),
+        _s("shard_ack",
+           ["dataset", "task_id", "success", "start", "end", "worker"]),
+        # -- session resync (master crash recovery) ------------------
+        _s("agent_resync", [
+            "node_id", "node_rank", "restart_count", "last_step",
+            "last_acked_dataset", "last_acked_task",
+        ]),
+        _s("master_resync", [
+            "node_id", "incarnation", "recoveries", "rdzv_round",
+            "master_changed", "last_step",
+        ]),
+        # -- trainer -------------------------------------------------
+        _s("train_step", ["step", "restart_count", "node_rank"]),
+        _s("loss_spike", ["step", "loss", "ema", "factor"]),
+        # -- checkpoint (open phase dicts: stage timings vary) -------
+        _s("checkpoint_shm_save", ["step", "rank"],
+           allow_extra=True),
+        _s("checkpoint_restore", ["step", "tier", "rank"],
+           allow_extra=True),
+        _s("checkpoint_persist", ["step", "ok", "seconds"]),
+        _s("checkpoint_commit", ["step"]),
+        # -- agent ---------------------------------------------------
+        _s("worker_restart", ["node_rank", "restart_count"]),
+        _s("warm_fork_fallback", [
+            "node_rank", "local_rank", "restart_count", "reason",
+        ]),
+        _s("node_check", ["round", "elapsed_s", "world_size"]),
+        # -- diagnosis / chaos ---------------------------------------
+        _s("diagnosis_verdict",
+           ["hung", "action", "culprit_node", "reason"]),
+        _s("chaos_inject", [
+            "scenario", "seed", "seq", "point", "rule", "action",
+            "step", "node_rank",
+        ]),
+        # -- flight recorder (this PR) -------------------------------
+        _s("goodput_attribution", [
+            "window_start", "window_end", "window_s", "training_s",
+            "loss_s", "goodput", "buckets",
+        ]),
+    )
+}
+
+
+def validate_event(record: Dict) -> List[str]:
+    """Problems with one recorded event dict (empty = valid)."""
+    problems: List[str] = []
+    etype = record.get("type")
+    if not isinstance(etype, str) or not etype:
+        return ["event record has no 'type'"]
+    schema = EVENT_SCHEMAS.get(etype)
+    if schema is None:
+        return [f"unregistered event type {etype!r}"]
+    missing = schema.required - set(record)
+    if missing:
+        problems.append(
+            f"{etype}: missing required field(s) {sorted(missing)}"
+        )
+    if not schema.allow_extra:
+        extra = set(record) - schema.known
+        if extra:
+            problems.append(
+                f"{etype}: unregistered field(s) {sorted(extra)}"
+            )
+    return problems
+
+
+def validate_call(
+    event_type: str,
+    kwarg_names: Sequence[str],
+    has_dynamic: bool = False,
+    where: str = "",
+) -> List[str]:
+    """Problems with one ``emit_event(type, ...)`` call site.
+
+    ``has_dynamic`` marks a ``**kwargs`` expansion at the site: the
+    literal keywords are still checked against the registry, but
+    required-field completeness cannot be decided statically and is
+    left to the recorded-log check."""
+    loc = f" at {where}" if where else ""
+    schema = EVENT_SCHEMAS.get(event_type)
+    if schema is None:
+        return [f"unregistered event type {event_type!r}{loc}"]
+    problems: List[str] = []
+    names = set(kwarg_names)
+    if not schema.allow_extra:
+        drift = names - schema.required - schema.optional
+        if drift:
+            problems.append(
+                f"{event_type}: unregistered field(s) "
+                f"{sorted(drift)}{loc}"
+            )
+    if not has_dynamic:
+        missing = schema.required - names
+        if missing:
+            problems.append(
+                f"{event_type}: call omits required field(s) "
+                f"{sorted(missing)}{loc}"
+            )
+    return problems

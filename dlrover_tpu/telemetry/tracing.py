@@ -82,6 +82,21 @@ class Tracer:
         )
         self._finished: "deque[Span]" = deque(maxlen=max_finished)
         self._lock = threading.Lock()
+        # push exporters (OTLP) subscribe here instead of patching
+        # instrumentation sites: every finished span is handed to each
+        # listener, failures swallowed (telemetry must never raise)
+        self._listeners: List = []
+
+    def add_listener(self, fn):
+        """Register ``fn(span)`` called once per finished span."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn):
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     @contextmanager
     def span(self, name: str, **attributes):
@@ -110,6 +125,12 @@ class Tracer:
     def _record(self, s: Span):
         with self._lock:
             self._finished.append(s)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(s)
+            except Exception:  # noqa: BLE001 - exporter bug must not
+                pass  # kill the instrumented operation
         try:
             self._duration_hist.observe(s.duration, name=s.name)
         except Exception:  # noqa: BLE001 - telemetry must not raise

@@ -53,7 +53,8 @@ def pytest_collection_modifyitems(config, items):
     exporter/injector/engine instances, cleaned up by their own
     fixtures)."""
     early_files = (
-        "test_telemetry.py", "test_chaos.py",
+        "test_telemetry.py", "test_otlp.py", "test_timeline.py",
+        "test_chaos.py",
         "test_restore_pipeline.py", "test_master_journal.py",
         # the chaos acceptance e2e runs (worker kill, shm fallback,
         # master kill/restart) are the recovery regression net — a

@@ -353,6 +353,97 @@ def test_recovery_is_idempotent_across_restarts(tmp_path):
     assert m3.recoveries == 2
 
 
+def test_recovery_restores_network_check_results(tmp_path):
+    """ROADMAP satellite (ISSUE 5): the network-check rendezvous
+    RESULTS survive a mid-check master crash — previously only round
+    membership replayed, so a respawned master forgot every status/
+    elapsed report that had already arrived and fault confirmation
+    ("abnormal in two consecutive rounds") restarted from scratch."""
+    m1 = JobMaster(port=0, node_num=2, job_name="nc",
+                   journal_dir=str(tmp_path / "j"))
+    nc = m1.network_rdzv
+    for rank in (0, 1):
+        nc.join_rendezvous(rank, rank, 1, "127.0.0.1")
+    rnd, group, world, _c = nc.get_comm_world(0)
+    assert rnd == 1 and world  # round complete, groups built
+    # reports flow through the servicer so the journal hook fires
+    m1.servicer.report(0, "worker", msg.NetworkStatusRequest(
+        node_id=0, normal=True, elapsed_time=1.0))
+    m1.servicer.report(1, "worker", msg.NetworkStatusRequest(
+        node_id=1, normal=False, elapsed_time=9.0))
+    fault_before = nc.check_fault_node()
+    stragglers_before = nc.detect_stragglers()
+    assert fault_before == ([1], "need-second-round")
+    assert stragglers_before[0] == [1]
+    m1._server.stop()  # crash: no graceful snapshot
+
+    m2 = JobMaster(port=0, node_num=2, job_name="nc",
+                   journal_dir=str(tmp_path / "j"))
+    try:
+        nc2 = m2.network_rdzv
+        # the check verdicts are identical across the crash
+        assert nc2.check_fault_node() == fault_before
+        assert nc2.detect_stragglers() == stragglers_before
+        # the pairwise grouping survives: a re-joining agent polling
+        # get_comm_world sees its group again
+        rnd2, _g2, world2, _c2 = nc2.get_comm_world(0)
+        assert rnd2 == 1 and world2 == {0: 1, 1: 1}
+        # and the snapshot path carries the same state (graceful
+        # stop folds it in; a 3rd incarnation replays snapshot-only)
+        m2.stop()
+        m3 = JobMaster(port=0, node_num=2, job_name="nc",
+                       journal_dir=str(tmp_path / "j"))
+        assert m3.network_rdzv.check_fault_node() == fault_before
+        assert m3.network_rdzv.detect_stragglers() == (
+            stragglers_before
+        )
+        m3._server.stop()
+    finally:
+        m2._server.stop()
+
+
+def test_netcheck_round2_grouping_identical_across_crash(tmp_path):
+    """Review regression: round ≥ 2 groups fastest-with-slowest by
+    the PREVIOUS round's elapsed times.  Replay must rebuild groups
+    with the same ordering the live path used (times read BEFORE the
+    check-round counter advances) — with 4 nodes whose times force a
+    non-neighbour pairing, a divergent rebuild would pair different
+    members than the pre-crash agents were already given."""
+    m1 = JobMaster(port=0, node_num=4, job_name="nc2",
+                   journal_dir=str(tmp_path / "j"))
+    nc = m1.network_rdzv
+    for rank in range(4):
+        nc.join_rendezvous(rank, rank, 1, "127.0.0.1")
+    rnd, _g, world, _c = nc.get_comm_world(0)
+    assert rnd == 1 and world
+    # neighbour-pair times that sort into a DIFFERENT round-2 pairing
+    for node, elapsed in ((0, 1.0), (1, 2.0), (2, 8.0), (3, 9.0)):
+        m1.servicer.report(node, "worker", msg.NetworkStatusRequest(
+            node_id=node, normal=True, elapsed_time=elapsed))
+    for rank in range(4):
+        nc.join_rendezvous(rank, rank, 1, "127.0.0.1")
+    rnd, _g, _w, _c = nc.get_comm_world(0)
+    assert rnd == 2
+    groups_before = nc.journal_state()["check"]["groups"]
+    # fastest-with-slowest: {0,3} and {1,2}, not neighbours
+    assert sorted(sorted(g) for g in groups_before) == [[0, 3], [1, 2]]
+    m1._server.stop()  # crash: entry replay only, no snapshot
+
+    m2 = JobMaster(port=0, node_num=4, job_name="nc2",
+                   journal_dir=str(tmp_path / "j"))
+    try:
+        check = m2.network_rdzv.journal_state()["check"]
+        assert check["groups"] == groups_before
+        assert check["check_round"] == 2
+        # every rank polling the recovered master sees its pre-crash
+        # group world
+        for rank, peers in ((0, {0: 1, 3: 1}), (1, {1: 1, 2: 1})):
+            _r, _g, world, _c = m2.network_rdzv.get_comm_world(rank)
+            assert world == peers
+    finally:
+        m2._server.stop()
+
+
 def test_journaled_job_exit_decision_honored(tmp_path):
     m1 = JobMaster(port=0, node_num=1, job_name="jx",
                    journal_dir=str(tmp_path / "j"))

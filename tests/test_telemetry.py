@@ -294,6 +294,42 @@ def test_read_events_skips_torn_lines(tmp_path):
     assert [e["type"] for e in events] == ["ok"]
 
 
+def test_read_events_skips_torn_trailing_binary_tail(tmp_path):
+    """ISSUE 5 satellite regression: a process SIGKILLed mid-write
+    (every chaos kill scenario) can truncate the trailing line inside
+    a multi-byte UTF-8 sequence or leave raw garbage bytes; reading
+    must skip the torn tail — mirroring the journal's prefix-
+    consistent replay — instead of raising UnicodeDecodeError into
+    the invariant checkers / timeline assembly."""
+    good = (
+        json.dumps({"schema": 1, "type": "ok", "i": 0}) + "\n"
+        + json.dumps({"schema": 1, "type": "ok", "i": 1}) + "\n"
+    ).encode()
+    # a record with a multi-byte char, truncated INSIDE the char
+    torn_unicode = json.dumps(
+        {"schema": 1, "type": "torn", "msg": "café"},
+        ensure_ascii=False,
+    ).encode()[:-4]
+    for tail in (
+        torn_unicode,
+        b"\xff\xfe\x00garbage",  # raw non-UTF8 bytes
+        b'{"schema": 1, "type": "torn"',  # plain mid-line kill
+    ):
+        path = tmp_path / "t.jsonl"
+        path.write_bytes(good + tail)
+        events = list(read_events(str(path)))  # must not raise
+        assert [e["i"] for e in events] == [0, 1]
+    # a torn line mid-file (concurrent writer) skips only that line
+    path = tmp_path / "mid.jsonl"
+    path.write_bytes(
+        good[: good.index(b"\n") + 1]
+        + b"\xff\xfe broken \xff\n"
+        + good[good.index(b"\n") + 1:]
+    )
+    events = list(read_events(str(path)))
+    assert [e["i"] for e in events] == [0, 1]
+
+
 # -- export surfaces ------------------------------------------------------
 
 

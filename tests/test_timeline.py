@@ -1,0 +1,473 @@
+"""Flight recorder (ISSUE 5 tentpole, parts 2+3): timeline assembly
+from a RECORDED ``master_kill_restart_midround`` chaos event log,
+Chrome trace-event rendering, the plain-text incident report, the
+``/timeline`` endpoint, goodput-loss attribution (cause buckets sum
+to the measured loss), the Brain feed, and the event-schema checker
+wired as tier-1."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.telemetry import timeline as tl
+from dlrover_tpu.telemetry.events import (
+    EVENTS_AGGREGATE_ENV,
+    collect_events,
+    read_events,
+)
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures",
+    "master_kill_restart_midround_events.jsonl",
+)
+
+
+@pytest.fixture(scope="module")
+def fixture_events():
+    return collect_events([FIXTURE])
+
+
+@pytest.fixture(scope="module")
+def fixture_timeline(fixture_events):
+    return tl.assemble(fixture_events)
+
+
+# -- assembly from the recorded master-kill run ----------------------------
+
+
+def test_fixture_assembles_recovery_trail(fixture_timeline):
+    jt = fixture_timeline
+    assert jt.master_incarnations == 2
+    # rendezvous slice from the round-1 completion
+    rdzv = jt.slices_by_cat(tl.CAUSE_RENDEZVOUS)
+    assert any("round 1" in s.name for s in rdzv)
+    # the recovery window (kill -> resyncs) plus the journal.replay
+    # span nested inside it
+    recovery = jt.slices_by_cat(tl.CAUSE_MASTER_RECOVERY)
+    assert any(s.name == "journal.replay" for s in recovery)
+    (rec,) = [
+        s for s in recovery if s.meta.get("recoveries") == 1
+    ]
+    kill = next(
+        e for e in jt.events
+        if e.get("type") == "chaos_inject"
+        and e.get("point") == "master.task_dispatch"
+    )
+    resyncs = [
+        e for e in jt.events
+        if e.get("type") in ("agent_resync", "master_resync")
+    ]
+    assert rec.start <= kill["ts"]
+    assert rec.end >= max(e["ts"] for e in resyncs)
+    # shard leases paired dispatch->ack, exactly once each
+    leases = jt.slices_by_cat("shard_lease")
+    assert len(leases) == 8
+    assert all(s.end >= s.start for s in leases)
+    # training window spans the 8 steps
+    steps = [
+        e for e in jt.events if e.get("type") == "train_step"
+    ]
+    assert jt.window == (steps[0]["ts"], steps[-1]["ts"])
+
+
+def test_fixture_chrome_trace_round_trips(fixture_timeline):
+    doc = tl.to_chrome_trace(fixture_timeline)
+    parsed = json.loads(json.dumps(doc))  # valid JSON end to end
+    events = parsed["traceEvents"]
+    assert events
+    cats = {e.get("cat") for e in events if "cat" in e}
+    assert tl.CAUSE_RENDEZVOUS in cats
+    assert tl.CAUSE_MASTER_RECOVERY in cats
+    assert "train_step" in cats
+    # every slice is well-formed: non-negative ts, positive dur
+    for e in events:
+        if e.get("ph") == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 1
+            assert isinstance(e["pid"], int)
+    # track names are declared via metadata records
+    names = {
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "master" in names
+    assert parsed["otherData"]["master_incarnations"] == 2
+
+
+def test_fixture_attribution_buckets_cover_loss(fixture_timeline):
+    attr = tl.attribute_goodput_loss(fixture_timeline)
+    assert attr["window_s"] > 0
+    assert attr["loss_s"] > 0  # the outage is real non-training time
+    total = sum(attr["buckets"].values())
+    # every non-training second lands in a bucket (>= 90% required by
+    # the acceptance criteria; construction gives ~100%)
+    assert total >= 0.9 * attr["loss_s"]
+    assert total == pytest.approx(attr["loss_s"], rel=0.02)
+    # non-tautological: NAMED causes (not 'unattributed') explain the
+    # recorded outage
+    named = total - attr["buckets"][tl.CAUSE_UNATTRIBUTED]
+    assert named >= 0.8 * attr["loss_s"], attr["buckets"]
+    # and the dominant cause of a master-kill run IS master recovery
+    assert attr["buckets"][tl.CAUSE_MASTER_RECOVERY] > 0
+    assert attr["buckets"][tl.CAUSE_MASTER_RECOVERY] >= 0.5 * (
+        attr["loss_s"]
+    )
+    assert 0.0 <= attr["goodput"] <= 1.0
+
+
+def test_fixture_report_renders(fixture_timeline):
+    report = tl.to_report(fixture_timeline)
+    assert "goodput-loss attribution" in report
+    assert "master_recovery" in report
+    assert "master recovery #1" in report
+    assert "kill@master.task_dispatch" in report
+
+
+def test_timeline_cli_chrome_and_report(tmp_path):
+    """Acceptance: ``python -m dlrover_tpu.telemetry.timeline`` on the
+    recorded events emits valid Chrome trace JSON + an attribution
+    report."""
+    out = subprocess.run(  # noqa: S603
+        [sys.executable, "-m", "dlrover_tpu.telemetry.timeline",
+         FIXTURE, "--chrome", "-"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["traceEvents"]
+    attr = doc["otherData"]["goodput_attribution"]
+    assert sum(attr["buckets"].values()) >= 0.9 * attr["loss_s"]
+    chrome_path = tmp_path / "trace.json"
+    out = subprocess.run(  # noqa: S603
+        [sys.executable, "-m", "dlrover_tpu.telemetry.timeline",
+         FIXTURE, "--chrome", str(chrome_path), "--report"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "goodput-loss attribution" in out.stdout
+    assert json.loads(chrome_path.read_text())["traceEvents"]
+
+
+# -- synthetic assembly: restarts, restores, shipping glob -----------------
+
+
+def _emit_synthetic(path, t0=1000.0):
+    lines = [
+        dict(type="train_step", ts=t0 + 1.0, step=1,
+             restart_count=0, node_rank=0),
+        dict(type="train_step", ts=t0 + 1.2, step=2,
+             restart_count=0, node_rank=0),
+        dict(type="chaos_inject", ts=t0 + 1.3, scenario="s", seed=1,
+             seq=0, point="trainer.step", rule="r", action="kill",
+             step=2, node_rank=0, source="trainer"),
+        dict(type="worker_restart", ts=t0 + 1.5, node_rank=0,
+             restart_count=1, source="agent"),
+        dict(type="rendezvous_complete", ts=t0 + 2.0,
+             rdzv="elastic-training", round=2, nodes=[0],
+             wait_s=0.3, source="master"),
+        dict(type="checkpoint_restore", ts=t0 + 3.0, step=2,
+             tier="shm", rank=0, total_s=0.8, read_s=0.5,
+             assemble_s=0.2, h2d_s=0.1),
+        dict(type="train_step", ts=t0 + 3.2, step=3,
+             restart_count=1, node_rank=0),
+        dict(type="train_step", ts=t0 + 3.4, step=4,
+             restart_count=1, node_rank=0),
+    ]
+    with open(path, "w") as f:
+        for rec in lines:
+            rec.setdefault("source", "trainer")
+            rec.setdefault("schema", 1)
+            rec.setdefault("pid", 7)
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_restart_and_restore_slices(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    _emit_synthetic(path)
+    jt = tl.assemble(collect_events([str(path)]))
+    (restart,) = [s for s in jt.slices if s.cat == "restart"]
+    # worker_restart -> first step of incarnation 1
+    assert restart.start == pytest.approx(1001.5)
+    assert restart.end == pytest.approx(1003.2)
+    assert restart.meta["resumed"] is True
+    (restore,) = jt.slices_by_cat(tl.CAUSE_RESTORE)
+    assert restore.start == pytest.approx(1002.2)
+    assert restore.end == pytest.approx(1003.0)
+    assert restore.meta["tier"] == "shm"
+    attr = tl.attribute_goodput_loss(jt)
+    # the 2s fault gap decomposes: restore wins its overlap, the
+    # rendezvous/restart window claims the rest
+    assert attr["buckets"][tl.CAUSE_RESTORE] > 0
+    assert attr["buckets"][tl.CAUSE_RENDEZVOUS] > 0
+    assert sum(attr["buckets"].values()) == pytest.approx(
+        attr["loss_s"], rel=0.02
+    )
+
+
+def test_long_outage_still_finds_death_witness(tmp_path):
+    """Review regression: a recovery landing >30s after the kill
+    (respawn backoff, big journal replay) must still anchor the
+    recovery slice at the death witness, not at master_recovered."""
+    t0 = 2000.0
+    records = [
+        dict(type="train_step", ts=t0, step=1, restart_count=0,
+             node_rank=0, source="trainer"),
+        dict(type="chaos_inject", ts=t0 + 1, scenario="s", seed=1,
+             seq=0, point="master.task_dispatch", rule="r",
+             action="kill", step=None, node_rank=0, source="master"),
+        dict(type="master_respawn", ts=t0 + 2, port=1, respawn=1,
+             rc=-9, source="agent"),
+        dict(type="master_recovered", ts=t0 + 45, job="j",
+             incarnation="x", recoveries=1, rdzv_round=1,
+             source="master"),
+        dict(type="train_step", ts=t0 + 46, step=2, restart_count=0,
+             node_rank=0, source="trainer"),
+    ]
+    path = tmp_path / "slow.jsonl"
+    with open(path, "w") as f:
+        for rec in records:
+            rec.setdefault("schema", 1)
+            rec.setdefault("pid", 3)
+            f.write(json.dumps(rec) + "\n")
+    jt = tl.assemble(collect_events([str(path)]))
+    (rec_slice,) = [
+        s for s in jt.slices_by_cat(tl.CAUSE_MASTER_RECOVERY)
+        if s.meta.get("recoveries") == 1
+    ]
+    assert rec_slice.start == pytest.approx(t0 + 1)  # the kill, not
+    assert rec_slice.end >= t0 + 45  # the recovery record
+
+
+def test_brain_feed_skips_jobs_that_never_trained(tmp_path):
+    """Review regression: lifecycle-only logs (no train_step) must
+    not persist a goodput=1.0 row for a job that never trained."""
+    from dlrover_tpu.brain.cluster_monitor import ingest_job_events
+    from dlrover_tpu.brain.datastore import SqliteJobMetricsStore
+
+    log = tmp_path / "lifecycle.jsonl"
+    log.write_text(json.dumps(
+        {"schema": 1, "ts": 1.0, "pid": 1, "source": "master",
+         "type": "master_start", "job": "j", "port": 1,
+         "node_num": 1, "metrics_port": 0}
+    ) + "\n")
+    store = SqliteJobMetricsStore(":memory:")
+    assert ingest_job_events(store, "dead-job", [str(log)]) is None
+    assert store.load_extras("dead-job") == []
+
+
+def test_collect_events_merges_shipped_logs(tmp_path):
+    """Agents ship per-node event logs; a glob folds them into one
+    ts-ordered stream (the event analog of the metrics textfile
+    aggregation)."""
+    master = tmp_path / "events.jsonl"
+    master.write_text(json.dumps(
+        {"schema": 1, "ts": 5.0, "pid": 1, "source": "master",
+         "type": "master_start", "job": "j", "port": 1,
+         "node_num": 2, "metrics_port": 0}
+    ) + "\n")
+    for rank, ts in ((0, 7.0), (1, 6.0)):
+        (tmp_path / f"events_node{rank}.jsonl").write_text(json.dumps(
+            {"schema": 1, "ts": ts, "pid": 2 + rank,
+             "source": "trainer", "type": "train_step", "step": 1,
+             "restart_count": 0, "node_rank": rank}
+        ) + "\n")
+    merged = collect_events(
+        [str(master), str(tmp_path / "events_node*.jsonl")]
+    )
+    assert [e["ts"] for e in merged] == [5.0, 6.0, 7.0]
+    # duplicate coverage (explicit path + glob) does not double-read
+    merged2 = collect_events(
+        [str(master), str(tmp_path / "events*.jsonl")]
+    )
+    assert len(merged2) == 3
+
+
+def test_collect_events_folds_rotated_backups(tmp_path):
+    """Review regression: a long job rotates events.jsonl ->
+    events.jsonl.1; assembly must fold the backups in (oldest first
+    by ts) or the timeline silently loses the job's early history."""
+    def rec(ts, i):
+        return json.dumps(
+            {"schema": 1, "ts": ts, "pid": 1, "source": "trainer",
+             "type": "train_step", "step": i, "restart_count": 0,
+             "node_rank": 0}
+        ) + "\n"
+
+    live = tmp_path / "events.jsonl"
+    (tmp_path / "events.jsonl.2").write_text(rec(1.0, 1))
+    (tmp_path / "events.jsonl.1").write_text(rec(2.0, 2))
+    live.write_text(rec(3.0, 3))
+    merged = collect_events([str(live)])
+    assert [e["step"] for e in merged] == [1, 2, 3]
+    # glob sources fold each match's backups too
+    merged = collect_events([str(tmp_path / "events*.jsonl")])
+    assert [e["step"] for e in merged] == [1, 2, 3]
+
+
+def test_timeline_endpoint_serves_chrome_and_report(tmp_path):
+    from dlrover_tpu.telemetry.exporter import PrometheusEndpoint
+    from dlrover_tpu.telemetry.metrics import MetricsRegistry
+
+    ep = PrometheusEndpoint(
+        port=0, host="127.0.0.1", registry=MetricsRegistry(),
+        event_sources=[FIXTURE],
+    )
+    ep.start()
+    try:
+        url = f"http://127.0.0.1:{ep.port}/timeline"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            doc = json.loads(resp.read().decode())
+        assert doc["traceEvents"]
+        assert "goodput_attribution" in doc["otherData"]
+        with urllib.request.urlopen(
+            url + "?format=report", timeout=10
+        ) as resp:
+            body = resp.read().decode()
+        assert "goodput-loss attribution" in body
+    finally:
+        ep.stop()
+
+
+def test_timeline_endpoint_default_sources_env(tmp_path, monkeypatch):
+    from dlrover_tpu.telemetry.exporter import PrometheusEndpoint
+    from dlrover_tpu.telemetry.metrics import MetricsRegistry
+
+    shipped = tmp_path / "events_node0.jsonl"
+    shipped.write_text(json.dumps(
+        {"schema": 1, "ts": 1.0, "pid": 9, "source": "trainer",
+         "type": "train_step", "step": 1, "restart_count": 0,
+         "node_rank": 0}
+    ) + "\n")
+    monkeypatch.setenv(
+        EVENTS_AGGREGATE_ENV, str(tmp_path / "events_node*.jsonl")
+    )
+    monkeypatch.delenv("DLROVER_EVENT_LOG", raising=False)
+    ep = PrometheusEndpoint(
+        port=0, host="127.0.0.1", registry=MetricsRegistry()
+    )
+    ep.start()
+    try:
+        url = f"http://127.0.0.1:{ep.port}/timeline"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+        steps = [
+            e for e in doc["traceEvents"]
+            if e.get("cat") == "train_step"
+        ]
+        assert steps  # the shipped agent log was folded in
+    finally:
+        ep.stop()
+
+
+def test_publish_attribution_gauges_and_event(tmp_path, monkeypatch):
+    from dlrover_tpu.telemetry.metrics import MetricsRegistry
+
+    log = tmp_path / "out.jsonl"
+    monkeypatch.setenv("DLROVER_EVENT_LOG", str(log))
+    jt = tl.assemble(collect_events([FIXTURE]))
+    attr = tl.attribute_goodput_loss(jt)
+    reg = MetricsRegistry()
+    tl.publish_attribution(attr, registry=reg)
+    gauge = reg.get("dlrover_goodput_loss_seconds")
+    assert gauge.value(cause=tl.CAUSE_MASTER_RECOVERY) == (
+        attr["buckets"][tl.CAUSE_MASTER_RECOVERY]
+    )
+    assert gauge.value(cause=tl.CAUSE_UNATTRIBUTED) == (
+        attr["buckets"][tl.CAUSE_UNATTRIBUTED]
+    )
+    (event,) = [
+        e for e in read_events(str(log))
+        if e["type"] == "goodput_attribution"
+    ]
+    assert event["loss_s"] == attr["loss_s"]
+    assert event["buckets"][tl.CAUSE_MASTER_RECOVERY] > 0
+
+
+def test_brain_feed_consumes_operator_numbers(tmp_path):
+    """The Brain datastore records the SAME attribution the operator
+    sees on /timeline (ISSUE 5: diagnosis consumes one set of
+    numbers)."""
+    from dlrover_tpu.brain.cluster_monitor import ingest_job_events
+    from dlrover_tpu.brain.datastore import SqliteJobMetricsStore
+
+    store = SqliteJobMetricsStore(":memory:")
+    attr = ingest_job_events(store, "job-x", [FIXTURE])
+    assert attr is not None and attr["loss_s"] > 0
+    (row,) = store.load_extras("job-x")
+    assert row["event"] == "goodput_attribution"
+    assert row["goodput"] == attr["goodput"]
+    assert row["loss_master_recovery_s"] == (
+        attr["buckets"][tl.CAUSE_MASTER_RECOVERY]
+    )
+    # empty logs are a no-op, not a crash
+    assert ingest_job_events(
+        store, "job-x", [str(tmp_path / "missing.jsonl")]
+    ) is None
+
+
+# -- event-schema registry (CI satellite) ----------------------------------
+
+
+def test_event_schema_call_sites_clean():
+    """Tier-1 gate: every emit_event call site in the package uses a
+    registered type with registered fields."""
+    from dlrover_tpu.telemetry.check_events import check_call_sites
+
+    assert check_call_sites() == []
+
+
+def test_event_schema_fixture_log_clean():
+    from dlrover_tpu.telemetry.check_events import check_logs
+
+    assert check_logs([FIXTURE]) == []
+
+
+def test_event_schema_catches_drift(tmp_path):
+    from dlrover_tpu.telemetry.check_events import (
+        check_logs,
+        check_source,
+    )
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from dlrover_tpu.telemetry.events import emit_event\n"
+        "emit_event('totally_new_event', x=1)\n"
+        "emit_event('train_step', step=1, restart_count=0,\n"
+        "           node_rank=0, stepp=2)\n"
+        "emit_event('worker_restart', node_rank=0)\n"
+    )
+    problems = check_source(str(bad))
+    assert any("unregistered event type" in p for p in problems)
+    assert any("stepp" in p for p in problems)
+    assert any(
+        "omits required" in p and "restart_count" in p
+        for p in problems
+    )
+    log = tmp_path / "bad.jsonl"
+    log.write_text(
+        json.dumps({"schema": 1, "ts": 1.0, "pid": 1,
+                    "source": "x", "type": "mystery"}) + "\n"
+        + json.dumps({"schema": 1, "ts": 1.0, "pid": 1,
+                      "source": "x", "type": "train_step",
+                      "step": 1}) + "\n"
+    )
+    problems = check_logs([str(log)])
+    assert any("mystery" in p for p in problems)
+    assert any("missing required" in p for p in problems)
+
+
+def test_check_events_cli(tmp_path):
+    out = subprocess.run(  # noqa: S603
+        [sys.executable, "-m",
+         "dlrover_tpu.telemetry.check_events", FIXTURE],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "event schema OK" in out.stdout
