@@ -41,6 +41,21 @@ _HOST_MEM_GAUGE = _REG.gauge(
 )
 
 
+def read_metrics_record(path: str) -> Optional[Dict]:
+    """One atomic read of the trainer-written runtime-metrics file
+    (written via tmp+rename, so a whole JSON object or nothing).
+    Shared by the training monitor, the step-phase collector and the
+    hang watchdog; None when absent/unparsable."""
+    try:
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            record = json.load(f)
+        return record if isinstance(record, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
 def get_host_stats() -> Dict[str, float]:
     """CPU percent + used memory MB for this host."""
     if psutil is not None:
@@ -196,7 +211,13 @@ class HeartbeatReporter:
         while not self._stopped.wait(self._interval):
             try:
                 with _REPORT_SECONDS.time(monitor="heartbeat"):
-                    self.last_action = self._client.report_heartbeat()
+                    action = self._client.report_heartbeat()
+                # the master delivers an action exactly once (popped
+                # from its queue on this ack): an empty later ack
+                # must not clobber one the agent loop has not
+                # consumed yet — the consumer clears it
+                if action:
+                    self.last_action = action
             except Exception as e:  # noqa: BLE001
                 _REPORT_ERRORS_TOTAL.inc(monitor="heartbeat")
                 logger.warning("heartbeat failed: %s", e)

@@ -25,6 +25,7 @@ from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu import chaos as _chaos
+from dlrover_tpu.agent.diagnosis import DiagnosisMonitor, HangWatchdog
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.monitor import (
     HeartbeatReporter,
@@ -34,6 +35,7 @@ from dlrover_tpu.agent.monitor import (
 from dlrover_tpu.agent.node_check import run_node_check
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.constants import (
+    MasterAction,
     NetworkCheckConstant,
     NodeEnv,
     NodeExitReason,
@@ -236,6 +238,8 @@ class ElasticTrainingAgent:
             # first restart needs it
             self._forkserver._ensure_template()
         self._monitors = []
+        self._heartbeat: Optional[HeartbeatReporter] = None
+        self._hang_watchdog: Optional[HangWatchdog] = None
         if start_monitors:
             # report cadence: 15 s suits production; the chaos/bench
             # harnesses shorten it so the master's speed/goodput
@@ -249,6 +253,14 @@ class ElasticTrainingAgent:
                 )
             except ValueError:
                 report_interval = 15.0
+            self._heartbeat = HeartbeatReporter(
+                interval=report_interval, client=self._client
+            )
+            # live pids of the supervised worker tree for the stack
+            # collector and the hang watchdog's /proc capture
+            worker_pids = lambda: [  # noqa: E731
+                p.pid for p in self._procs if p.poll() is None
+            ]
             self._monitors = [
                 ResourceMonitor(
                     interval=report_interval, client=self._client
@@ -258,10 +270,23 @@ class ElasticTrainingAgent:
                     interval=report_interval,
                     client=self._client,
                 ),
-                HeartbeatReporter(
-                    interval=report_interval, client=self._client
+                self._heartbeat,
+                # evidence loop: stacks / chip metrics / step times /
+                # step-phase breakdowns to the master's diagnosis chain
+                DiagnosisMonitor(
+                    interval=max(report_interval * 4, 4.0),
+                    client=self._client,
+                    worker_pids_fn=worker_pids,
+                ),
+                # hang flight data: no-step-progress past the
+                # threshold captures stacks + /proc state and ships
+                # them (DLROVER_HANG_THRESHOLD_S tunes the window)
+                HangWatchdog(
+                    worker_pids_fn=worker_pids,
+                    client=self._client,
                 ),
             ]
+            self._hang_watchdog = self._monitors[-1]
             from dlrover_tpu.agent.preemption import (
                 PreemptionMonitor,
                 monitor_enabled,
@@ -543,6 +568,14 @@ class ElasticTrainingAgent:
         otlp = otlp_from_env(service_name="dlrover_tpu.agent")
         if otlp is not None:
             otlp.start()
+        # GCP-native sink behind the same interfaces
+        from dlrover_tpu.telemetry.gcp_monitoring import (
+            maybe_from_env as gcp_from_env,
+        )
+
+        gcp = gcp_from_env()
+        if gcp is not None:
+            gcp.start()
         for m in self._monitors:
             m.start()
         try:
@@ -554,6 +587,8 @@ class ElasticTrainingAgent:
                 dumper.stop()
             if otlp is not None:
                 otlp.stop()
+            if gcp is not None:
+                gcp.stop()
             if self._forkserver is not None:
                 self._forkserver.close()
 
@@ -575,6 +610,22 @@ class ElasticTrainingAgent:
         self._save_ckpt_at_breakpoint()
         self._stop_workers()
         self._initialize_workers()
+        if self._hang_watchdog is not None:
+            # the recovery window (respawn + restore + retrace) must
+            # not read as a stall of the fresh incarnation
+            self._hang_watchdog.reset()
+
+    def _pop_master_action(self) -> str:
+        """Consume the action the master piggybacked on the last
+        heartbeat ack (the diagnosis chain's culprit-only relaunch
+        rides this channel: the master cannot reach into another
+        host's process tree, but the agent supervising the hung
+        trainer can)."""
+        hb = self._heartbeat
+        if hb is None:
+            return ""
+        action, hb.last_action = hb.last_action, ""
+        return action
 
     def _invoke_run(self) -> int:
         """Reference: _invoke_run (training.py:580)."""
@@ -590,6 +641,27 @@ class ElasticTrainingAgent:
                 procs=self._procs,
                 restart_count=self._restart_count,
             )
+            action = self._pop_master_action()
+            if action == MasterAction.RESTART_WORKERS:
+                # the master diagnosed THIS node as the hang culprit:
+                # restart only our workers (checkpoint breakpoint save
+                # included); healthy peers never see a restart
+                logger.warning(
+                    "master requested a worker restart (hang "
+                    "diagnosis); restarting local workers"
+                )
+                if self._restart_count >= self._spec.max_restarts:
+                    logger.error(
+                        "max restarts (%s) exhausted; cannot honor "
+                        "master restart request",
+                        self._spec.max_restarts,
+                    )
+                    self._save_ckpt_at_breakpoint()
+                    self._stop_workers()
+                    self._client.ready_to_exit("failed")
+                    return 1
+                self._restart_workers()
+                continue
             state, codes = self._monitor_workers()
             if state == WorkerState.SUCCEEDED:
                 logger.info("all workers finished successfully")

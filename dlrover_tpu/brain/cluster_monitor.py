@@ -51,17 +51,48 @@ def record_goodput_attribution(
     )
 
 
+def record_diagnosis_verdicts(
+    store, job_name: str, events: Iterable[Dict]
+) -> int:
+    """Persist every ``diagnosis_verdict`` event (hang / straggler /
+    data-starved, with measured durations) into the Brain datastore —
+    the cluster-level optimizer learns which nodes and jobs hang or
+    straggle, not just how much goodput was lost.  Returns the row
+    count."""
+    n = 0
+    for e in events:
+        if e.get("type") != "diagnosis_verdict":
+            continue
+        store.persist(
+            JobMetricRecord(
+                job_name=job_name,
+                timestamp=float(e.get("ts") or time.time()),
+                finished=False,
+            ),
+            event="diagnosis_verdict",
+            verdict=e.get("verdict") or e.get("action"),
+            action=e.get("action"),
+            culprit_node=e.get("culprit_node"),
+            hung=bool(e.get("hung")),
+            stall_s=e.get("stall_s"),
+            duration_s=e.get("duration_s"),
+        )
+        n += 1
+    return n
+
+
 def ingest_job_events(
     store, job_name: str, sources: Iterable[str]
 ) -> Optional[Dict]:
     """Assemble a job's shipped event logs and persist the resulting
-    goodput diagnosis; returns the attribution (None when the logs
-    hold no training window)."""
+    goodput diagnosis + diagnosis verdicts; returns the attribution
+    (None when the logs hold no training window)."""
     from dlrover_tpu.telemetry import timeline as _timeline
 
     events = _timeline.collect_events(sources)
     if not events:
         return None
+    record_diagnosis_verdicts(store, job_name, events)
     tl = _timeline.assemble(events)
     if tl.window is None:
         # lifecycle events but no train_step: the job never trained,

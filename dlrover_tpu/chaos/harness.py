@@ -258,6 +258,124 @@ class DiagnosisEmitted(Invariant):
         return InvariantResult(self.name, True, hits[0].get("reason", ""))
 
 
+class HangDiagnosed(Invariant):
+    """Deep-diagnosis invariant: within ``within_s`` of the injected
+    stall, the master reached a *hung* verdict that carries captured
+    stack evidence and a measured stall duration, fed by at least one
+    agent ``hang_evidence`` capture (stacks present)."""
+
+    name = "hang_diagnosed"
+
+    def __init__(self, within_s: float = 30.0):
+        self.within_s = within_s
+
+    def check(self, events, run):
+        stalls = [
+            e for e in _injections(events)
+            if e.get("action") == "stall"
+        ]
+        if not stalls:
+            return InvariantResult(
+                self.name, False, "no stall injection recorded"
+            )
+        t0 = stalls[0]["ts"]
+        evidence = [
+            e for e in events
+            if e.get("type") == "hang_evidence" and e["ts"] >= t0
+        ]
+        if not evidence:
+            return InvariantResult(
+                self.name, False,
+                "no hang_evidence capture after the stall (agent "
+                "watchdog never fired)",
+            )
+        if not any(e.get("stacks") for e in evidence):
+            return InvariantResult(
+                self.name, False,
+                "hang_evidence carries no stacks",
+            )
+        verdicts = [
+            e for e in events
+            if e.get("type") == "diagnosis_verdict"
+            and e.get("hung") and e["ts"] >= t0
+        ]
+        if not verdicts:
+            return InvariantResult(
+                self.name, False,
+                "no hung diagnosis_verdict after the stall",
+            )
+        v = verdicts[0]
+        gap = v["ts"] - t0
+        stall_s = v.get("stall_s")
+        if not isinstance(stall_s, (int, float)) or stall_s <= 0:
+            return InvariantResult(
+                self.name, False,
+                f"verdict carries no measured stall ({stall_s!r})",
+            )
+        if not v.get("evidence"):
+            return InvariantResult(
+                self.name, False,
+                "verdict carries no evidence excerpt",
+            )
+        if gap > self.within_s:
+            return InvariantResult(
+                self.name, False,
+                f"diagnosed after {gap:.1f}s > bound "
+                f"{self.within_s}s",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"hung verdict in {gap:.1f}s (stall {stall_s:.1f}s, "
+            f"{len(evidence)} evidence capture(s))",
+        )
+
+
+class OnlyCulpritRestarted(Invariant):
+    """A hang verdict must restart exactly the culprit node: at least
+    one restart happened, every restart is on ``culprit_rank``, and
+    the job was never aborted for the hang."""
+
+    def __init__(self, culprit_rank: int = 0):
+        self.culprit_rank = culprit_rank
+        self.name = f"only_culprit_node{culprit_rank}_restarted"
+
+    def check(self, events, run):
+        restarts = [
+            e for e in events if e.get("type") == "worker_restart"
+        ]
+        if not restarts:
+            return InvariantResult(
+                self.name, False,
+                "no worker_restart (culprit never relaunched)",
+            )
+        strays = [
+            e for e in restarts
+            if e.get("node_rank") != self.culprit_rank
+        ]
+        if strays:
+            return InvariantResult(
+                self.name, False,
+                f"{len(strays)} restart(s) on non-culprit nodes: "
+                f"{sorted({e.get('node_rank') for e in strays})}",
+            )
+        aborted = [
+            e for e in events
+            if e.get("type") == "master_exit"
+            and e.get("exit_reason") == "hang_error"
+        ]
+        if aborted:
+            return InvariantResult(
+                self.name, False,
+                "job aborted for the hang instead of a targeted "
+                "restart",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{len(restarts)} restart(s), all on culprit node "
+            f"{self.culprit_rank}",
+        )
+
+
 class DeterministicTimeline(Invariant):
     """The run's fault timeline equals a reference timeline (usually a
     prior run of the same scenario+seed)."""
@@ -934,6 +1052,22 @@ def invariants_for_scenario(
             BoundedStepLoss(ckpt_interval=max(ckpt_every, disk_every)),
             RestoredFromTier("storage"),
             TrainingCompleted(total_steps=total_steps),
+            NoOrphanProcesses(marker=workdir),
+        ]
+    if name == "trainer-hang-detected":
+        # the deep-diagnosis trail: evidence captured, hung verdict
+        # with stacks + measured stall, ONLY the culprit restarted,
+        # bounded loss, completion — and the loss attribution books
+        # the stall under the hang bucket with real durations
+        return [
+            HangDiagnosed(within_s=30.0),
+            OnlyCulpritRestarted(culprit_rank=0),
+            BoundedStepLoss(ckpt_interval=ckpt_every),
+            TrainingCompleted(total_steps=total_steps),
+            GoodputLossAttributed(
+                min_attributed_frac=0.75,
+                expect_cause=flight.CAUSE_HANG,
+            ),
             NoOrphanProcesses(marker=workdir),
         ]
     if name in RECOVERY_SCENARIOS:

@@ -124,25 +124,37 @@ if SHARD_DATASET:
         num_minibatches_per_shard=1, storage_type="table",
     )
     while True:
-        task = sc.fetch_task()
+        with trainer.profile("data_wait"):
+            task = sc.fetch_task()
         if task is None:
             break
-        state, metrics = step_fn(state, batch)
+        with trainer.profile("compute") as p:
+            state, metrics = step_fn(state, batch)
+            p.block(metrics)
         trainer.report_step(metrics)
         if STEP_SLEEP:
             time.sleep(STEP_SLEEP)
         sc.report_task_done(task.task_id)
-        after_step()
+        # books into the NEXT step's breakdown (the step is closed by
+        # report_step), which is where a save's stall is felt anyway
+        with trainer.profile("checkpoint"):
+            after_step()
     FINAL_STEP = trainer.global_step
 else:
     for i in range(start_step, TOTAL_STEPS):
-        state, metrics = step_fn(state, batch)
+        # the always-on profiler: compute bracketed by
+        # block_until_ready, so every train_step ships a real
+        # step_phases breakdown
+        with trainer.profile("compute") as p:
+            state, metrics = step_fn(state, batch)
+            p.block(metrics)
         # report_step emits the train_step event and fires the
         # trainer.step chaos hook — a kill rule ends the process HERE
         trainer.report_step(metrics)
         if STEP_SLEEP:
             time.sleep(STEP_SLEEP)
-        after_step()
+        with trainer.profile("checkpoint"):
+            after_step()
     FINAL_STEP = TOTAL_STEPS
 
 # final durable save, retried until the commit lands: a transient
@@ -484,6 +496,37 @@ def goodput_under_scheduled_churn(seed: int = 43) -> Scenario:
     })
 
 
+def trainer_hang_detected(seed: int = 47) -> Scenario:
+    """Deep-diagnosis acceptance (ISSUE 7): freeze one trainer
+    mid-step with the stall primitive (a sleep in the report path —
+    the process is alive, heartbeats flow, steps stop: exactly the
+    silent-hang class that is indistinguishable from slowness without
+    flight data).  The agent watchdog must capture stacks + /proc
+    state and ship ``hang_evidence``; the master's inference chain
+    must reach a *hung* verdict carrying that evidence and a measured
+    stall, and restart ONLY the culprit node through the
+    heartbeat-action relaunch path; the restored incarnation finishes
+    the budget.  Thresholds are shrunk via RUN_OPTIONS env so the
+    whole diagnosis plays out in seconds (tier-1)."""
+    return Scenario.from_dict({
+        "name": "trainer-hang-detected",
+        "seed": seed,
+        "rules": [{
+            "name": "freeze-midstep",
+            "point": "trainer.step",
+            "action": "stall",
+            "at_step": 5,
+            "max_count": 1,
+            "only_first_incarnation": True,
+            # far beyond every diagnosis threshold: the sleep is
+            # ended by the culprit restart's SIGTERM, never by the
+            # timer — a diagnosis that fails leaves the job hung
+            # until the harness timeout, not a silent pass
+            "args": {"seconds": 90.0},
+        }],
+    })
+
+
 def shm_corruption(seed: int = 17) -> Scenario:
     """Tear one shm snapshot right after it is written (writing=True
     republish): the persist and restore paths must refuse the torn
@@ -517,6 +560,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "warm_template_import_kill": warm_template_import_kill,
     "warm_template_midspawn_kill": warm_template_midspawn_kill,
     "goodput_under_scheduled_churn": goodput_under_scheduled_churn,
+    "trainer_hang_detected": trainer_hang_detected,
 }
 
 
@@ -581,6 +625,23 @@ RUN_OPTIONS: Dict[str, Dict] = {
     "warm-template-midspawn-kill": {"warm_restart": True},
     # run_scenario_multinode applies these to every agent process
     "multinode-rpc-partition": {"step_sleep": 0.5},
+    # hang diagnosis in seconds instead of half an hour: fast step
+    # reporting, a 2 s agent watchdog window, a 3 s master hang
+    # timeout and a sub-second master poll — the 90 s stall is
+    # diagnosed, evidenced and culprit-restarted long before the
+    # sleep could expire
+    "trainer-hang-detected": {
+        "extra_env": {
+            "DLROVER_MONITOR_REPORT_INTERVAL": "0.5",
+            "DLROVER_HANG_THRESHOLD_S": "2",
+            "DLROVER_HANG_TIMEOUT": "3",
+            "DLROVER_SECONDS_TO_CHECK_HANG": "0.5",
+            # the 3 s hang timeout is smaller than a cold restart;
+            # the post-restart grace keeps the recovery window from
+            # re-convicting the fresh incarnation
+            "DLROVER_HANG_RESTART_GRACE_S": "20",
+        },
+    },
 }
 
 

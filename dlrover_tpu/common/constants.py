@@ -91,6 +91,13 @@ class ErrorMonitorConstants:
     ACTION_NONE = "none"
 
 
+class MasterAction:
+    """Actions the master piggybacks on a heartbeat ack for the agent
+    to execute (the diagnosis chain's culprit-only relaunch path)."""
+
+    RESTART_WORKERS = "restart_workers"
+
+
 class CheckpointConstant:
     """Flash-checkpoint file naming (reference:
     common/constants.py CheckpointConstant + ckpt_saver commit files)."""

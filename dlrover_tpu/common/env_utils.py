@@ -15,6 +15,13 @@ def _get_int(name: str, default: int = 0) -> int:
         return default
 
 
+def _get_float(name: str, default: float = 0.0) -> float:
+    try:
+        return float(os.getenv(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
 def proc_stat_fields(pid: int) -> Optional[List[bytes]]:
     """Fields of ``/proc/<pid>/stat`` AFTER the comm field, or None
     when the pid is gone.  comm (field 2) may itself contain spaces or

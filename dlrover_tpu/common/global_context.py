@@ -5,6 +5,7 @@ flags, overridable from env for tests."""
 import os
 
 from dlrover_tpu.common.constants import DefaultPorts
+from dlrover_tpu.common.env_utils import _get_float as _env_float
 from dlrover_tpu.common.singleton import Singleton
 
 
@@ -19,9 +20,13 @@ class Context(Singleton):
         # heartbeat: node considered dead after this silence window
         # (reference: dist_job_manager.py:355 300s window)
         self.hang_detection_seconds = 300
-        # master main-loop hang checks
-        self.seconds_to_check_hang = 30
-        self.hang_timeout = 1800
+        # master main-loop hang checks (env-overridable: the chaos
+        # hang scenario shrinks both so a tier-1 run diagnoses a
+        # frozen trainer in seconds, not half an hour)
+        self.seconds_to_check_hang = _env_float(
+            "DLROVER_SECONDS_TO_CHECK_HANG", 30
+        )
+        self.hang_timeout = _env_float("DLROVER_HANG_TIMEOUT", 1800)
         # network check
         self.network_check_timeout = 300
         self.straggler_factor = 2.0

@@ -16,6 +16,7 @@ answers through the chain: is the job hung or dragged by a straggler,
 which node is the culprit, what action should the master take.
 """
 
+import json
 import statistics
 import time
 from abc import ABC, abstractmethod
@@ -46,6 +47,14 @@ class Diagnosis:
     culprit_node: int = -1
     action: str = ErrorMonitorConstants.ACTION_NONE
     reason: str = ""
+    # actionable-verdict fields: the one-word classification, the
+    # measured stall (hang) / excess time (straggler) — what the
+    # timeline's loss attribution claims instead of nominal guesses —
+    # and the evidence excerpt (agent-captured stacks / proc states)
+    verdict: str = ""  # "hung" | "straggler" | "data_starved" | ""
+    stall_s: float = 0.0
+    duration_s: float = 0.0
+    evidence: str = ""
     # the full conclusion set the chain reached (back-compat callers
     # can ignore it)
     inferences: List["Inference"] = field(default_factory=list)
@@ -107,6 +116,16 @@ class DiagnosisContext:
     speed_monitor: object = None
     hang_timeout: float = 1800.0
     straggler_ratio: float = 2.0
+    # a step whose data_wait dominates beyond this fraction is
+    # input-bound, not slow (the reference's "slow dataloader" class)
+    starved_ratio: float = 0.5
+    # only hang evidence captured this recently counts: a stale
+    # capture from before the last recovery must not re-trigger
+    evidence_window: float = 600.0
+    # heartbeat liveness source (distinguishes a HUNG trainer — agent
+    # alive, steps stopped — from a DEAD node the heartbeat monitor
+    # already handles)
+    job_manager: object = None
 
 
 class InferenceChain:
@@ -153,8 +172,16 @@ class InferenceChain:
 
 class HangCheckOperator(InferenceOperator):
     """"Is training hung?" -> the fact, from the speed monitor's
-    last-step timeline (reference:
-    ``operator/check_training_hang_operator.py``)."""
+    last-step timeline AND the agents' hang flight data (reference:
+    ``operator/check_training_hang_operator.py``).  Two witnesses:
+
+    - **silence**: no worker stepped for ``hang_timeout`` despite
+      registered, previously-stepping workers (the blunt signal);
+    - **evidence**: an agent watchdog measured ``stall_s`` past the
+      timeout ON the node and captured stacks — arriving while the
+      master's own clock may still be inside its window (the agent
+      sits next to the trainer; its measurement is the sharper one).
+    """
 
     def is_compatible(self, inf: Inference) -> bool:
         return (inf.name == InferName.TRAINING
@@ -162,19 +189,35 @@ class HangCheckOperator(InferenceOperator):
                 and inf.description == "hang")
 
     def infer(self, inf, ctx):
-        sm = ctx.speed_monitor
-        if sm is None:
+        if time.time() < ctx.manager.hang_suppressed_until:
+            # a culprit restart is in flight: the silence (and any
+            # late-arriving evidence) belongs to the recovery, not to
+            # a fresh hang
             return []
+        sm = ctx.speed_monitor
+        stall = 0.0
+        witness = ""
         # the guarded predicate: no verdict unless workers are
         # REGISTERED and have STEPPED at least once — a long startup
         # (scheduling, cold compile, restore) must not read as a hang
-        if sm.all_worker_hanged(ctx.hang_timeout):
+        if sm is not None and sm.all_worker_hanged(ctx.hang_timeout):
             stall = time.time() - sm.last_step_time
-            return [Inference(
-                InferName.TRAINING, InferAttr.IS, "hang",
-                detail=f"no step for {stall:.0f}s",
-            )]
-        return []
+            witness = "silence"
+        for node_id, (ts, payload) in (
+            ctx.manager.latest_hang_evidence().items()
+        ):
+            if time.time() - ts > ctx.evidence_window:
+                continue  # stale capture (pre-recovery)
+            ev_stall = float(payload.get("stall_s", 0.0) or 0.0)
+            if ev_stall >= ctx.hang_timeout and ev_stall > stall:
+                stall = ev_stall
+                witness = f"evidence(node {node_id})"
+        if not witness:
+            return []
+        return [Inference(
+            InferName.TRAINING, InferAttr.IS, "hang",
+            detail=f"no step for {stall:.1f}s [{witness}]",
+        )]
 
 
 class HangCulpritOperator(InferenceOperator):
@@ -210,18 +253,10 @@ class StragglerCheckOperator(InferenceOperator):
                 and inf.description == "straggler")
 
     def infer(self, inf, ctx):
-        per_node: Dict[int, float] = {}
-        for node_id, datas in ctx.manager._data.items():
-            times = [
-                float(d.content) for d in datas
-                if d.data_type == "step_time"
-            ]
-            if times:
-                per_node[node_id] = statistics.median(times)
-        if len(per_node) < 2:
+        stats = ctx.manager.straggler_stats()
+        if stats is None:
             return []
-        med = statistics.median(per_node.values())
-        worst_id, worst = max(per_node.items(), key=lambda kv: kv[1])
+        worst_id, worst, med, _n = stats
         if med > 0 and worst > ctx.straggler_ratio * med:
             return [Inference(
                 InferName.NODE, InferAttr.CAUSE, "straggler",
@@ -230,13 +265,51 @@ class StragglerCheckOperator(InferenceOperator):
         return []
 
 
+class DataStarvedOperator(InferenceOperator):
+    """"Is a trainer data-starved?" -> the node whose step-phase
+    breakdown shows the input pipeline dominating.  Raw material is
+    the trainer's always-on :class:`StepPhaseProfiler` shipped
+    through the agents' ``step_phases`` diagnosis data — a slow step
+    whose time goes to ``data_wait`` needs a faster input pipeline,
+    not a relaunch, and conflating the two wastes a restart."""
+
+    def is_compatible(self, inf: Inference) -> bool:
+        return (inf.name == InferName.TRAINING
+                and inf.attribution == InferAttr.IS_OR_NOT
+                and inf.description == "data_starved")
+
+    def infer(self, inf, ctx):
+        out: List[Inference] = []
+        for node_id, phases in ctx.manager.latest_step_phases(
+            max_age_s=ctx.evidence_window
+        ).items():
+            total = float(phases.get("total_s", 0.0) or 0.0)
+            wait = float(phases.get("data_wait", 0.0) or 0.0)
+            if total <= 0 or wait <= 0:
+                continue
+            frac = wait / total
+            if frac >= ctx.starved_ratio:
+                out.append(Inference(
+                    InferName.NODE, InferAttr.CAUSE, "data_starved",
+                    detail=(
+                        f"{node_id}:data_wait {wait:.3f}s of "
+                        f"{total:.3f}s/step ({frac:.0%})"
+                    ),
+                ))
+        return out
+
+
 class ResolutionOperator(InferenceOperator):
     """Node-cause facts -> the master's action (reference: the
-    Diagnostician's resolution step)."""
+    Diagnostician's resolution step).  ``data_starved`` resolves to
+    *record only*: a relaunch cannot make the input pipeline faster,
+    so the verdict is surfaced (event, Brain feed) without burning a
+    restart."""
 
     def is_compatible(self, inf: Inference) -> bool:
         return (inf.name == InferName.NODE
-                and inf.attribution == InferAttr.CAUSE)
+                and inf.attribution == InferAttr.CAUSE
+                and inf.description != "data_starved")
 
     def infer(self, inf, ctx):
         action = (
@@ -258,6 +331,7 @@ def default_operators() -> List[InferenceOperator]:
         HangCheckOperator(),
         HangCulpritOperator(),
         StragglerCheckOperator(),
+        DataStarvedOperator(),
         ResolutionOperator(),
     ]
 
@@ -268,6 +342,14 @@ class DiagnosisManager:
         self._data: Dict[int, Deque[DiagnosisData]] = defaultdict(
             lambda: deque(maxlen=window)
         )
+        # latest structured payloads per node: (received_at, payload)
+        self._hang_evidence: Dict[int, Tuple[float, Dict]] = {}
+        self._step_phases: Dict[int, Tuple[float, Dict]] = {}
+        # hang checks muted until this wall-clock time: set after the
+        # master ACTS on a hang verdict — the recovery (respawn +
+        # restore + retrace) would otherwise read as continued
+        # silence and re-convict the fresh incarnation mid-restart
+        self.hang_suppressed_until = 0.0
         self._chain = InferenceChain(
             operators if operators is not None
             else default_operators()
@@ -285,30 +367,115 @@ class DiagnosisManager:
                 )
             except (TypeError, ValueError):
                 pass
+        elif data.data_type in ("hang_evidence", "step_phases"):
+            # structured payloads are parsed once at ingest so the
+            # operators read dicts, not JSON strings
+            try:
+                payload = json.loads(data.content)
+            except (TypeError, ValueError):
+                return
+            if not isinstance(payload, dict):
+                return
+            store = (
+                self._hang_evidence
+                if data.data_type == "hang_evidence"
+                else self._step_phases
+            )
+            store[data.node_id] = (
+                data.timestamp or time.time(), payload
+            )
 
     def node_data(self, node_id: int) -> List[DiagnosisData]:
         return list(self._data.get(node_id, []))
+
+    def latest_hang_evidence(self) -> Dict[int, Tuple[float, Dict]]:
+        """Per-node ``(received_at, payload)`` of the newest agent
+        hang-flight-data capture."""
+        return dict(self._hang_evidence)
+
+    def latest_step_phases(
+        self, max_age_s: Optional[float] = None
+    ) -> Dict[int, Dict]:
+        """Per-node newest mean step-phase breakdown; with
+        ``max_age_s``, only breakdowns received that recently — a
+        stale report from a dead/scaled-away trainer must not keep
+        producing verdicts forever."""
+        now = time.time()
+        return {
+            node: payload
+            for node, (ts, payload) in self._step_phases.items()
+            if max_age_s is None or now - ts <= max_age_s
+        }
+
+    def clear_node(self, node_id: int):
+        """Drop a node's windowed data + evidence — called after the
+        master acts on a verdict (culprit restart), so stale evidence
+        cannot re-convict the fresh incarnation."""
+        self._data.pop(node_id, None)
+        self._hang_evidence.pop(node_id, None)
+        self._step_phases.pop(node_id, None)
+
+    def suppress_hang(self, grace_s: float):
+        """Mute hang conclusions for ``grace_s`` seconds (the
+        recovery window after a culprit restart)."""
+        self.hang_suppressed_until = max(
+            self.hang_suppressed_until, time.time() + grace_s
+        )
+
+    def straggler_stats(
+        self,
+    ) -> Optional[Tuple[int, float, float, int]]:
+        """``(worst_node, worst_median_s, overall_median_s,
+        worst_samples)`` over the windowed per-node step times; None
+        below two reporting nodes."""
+        per_node: Dict[int, Tuple[float, int]] = {}
+        for node_id, datas in self._data.items():
+            times = [
+                float(d.content) for d in datas
+                if d.data_type == "step_time"
+            ]
+            if times:
+                per_node[node_id] = (
+                    statistics.median(times), len(times)
+                )
+        if len(per_node) < 2:
+            return None
+        med = statistics.median(v[0] for v in per_node.values())
+        worst_id, (worst, n) = max(
+            per_node.items(), key=lambda kv: kv[1][0]
+        )
+        return worst_id, worst, med, n
 
     def diagnose(
         self,
         speed_monitor,
         hang_timeout: float = 1800.0,
         straggler_ratio: float = 2.0,
+        starved_ratio: float = 0.5,
+        job_manager=None,
     ) -> Diagnosis:
         """Run the inference chain over the standing problems
-        ("is training hung?", "is a straggler dragging it?") and fold
-        the conclusions into the legacy verdict shape (reference:
+        ("is training hung?", "is a straggler dragging it?", "is a
+        trainer data-starved?") and fold the conclusions into an
+        *actionable* verdict: classification, culprit, action,
+        measured durations and the evidence excerpt (reference:
         DiagnosisManager.start seeds the chain with the hang problem,
         ``master/diagnosis/diagnosis.py:40``)."""
         ctx = DiagnosisContext(
             manager=self, speed_monitor=speed_monitor,
             hang_timeout=hang_timeout,
             straggler_ratio=straggler_ratio,
+            starved_ratio=starved_ratio,
+            job_manager=job_manager,
         )
         problems = [
             Inference(InferName.TRAINING, InferAttr.IS_OR_NOT, "hang"),
             Inference(
                 InferName.TRAINING, InferAttr.IS_OR_NOT, "straggler"
+            ),
+            Inference(
+                InferName.TRAINING, InferAttr.IS_OR_NOT,
+                "data_starved",
             ),
         ]
         conclusions = self._chain.infer(problems, ctx)
@@ -340,8 +507,10 @@ class DiagnosisManager:
                 actions.add(c.description)
         # culprit precedence mirrors action severity: the node
         # blocking a collective (the hang's cause) outranks a
-        # straggler that merely slows the job
-        for cause in ("blocked_collective", "straggler"):
+        # straggler that merely slows the job; data starvation is a
+        # recorded cause, never a restart
+        for cause in ("blocked_collective", "straggler",
+                      "data_starved"):
             if cause in causes:
                 verdict.culprit_node = causes[cause]
                 break
@@ -353,10 +522,11 @@ class DiagnosisManager:
             if a in actions:
                 verdict.action = a
                 break
+        self._fold_measurements(verdict, causes, ctx)
         verdict.reason = "; ".join(reasons)
         if verdict.hung or verdict.action != (
             ErrorMonitorConstants.ACTION_NONE
-        ):
+        ) or causes:
             _VERDICT_TOTAL.inc(action=verdict.action)
             emit_event(
                 "diagnosis_verdict",
@@ -364,25 +534,120 @@ class DiagnosisManager:
                 action=verdict.action,
                 culprit_node=verdict.culprit_node,
                 reason=verdict.reason,
+                verdict=verdict.verdict,
+                stall_s=round(verdict.stall_s, 3),
+                duration_s=round(verdict.duration_s, 3),
+                evidence=verdict.evidence,
             )
         return verdict
 
+    # evidence excerpt cap: the verdict event must carry the proof,
+    # not the whole core dump
+    _EVIDENCE_EXCERPT = 2000
+
+    def _fold_measurements(
+        self, verdict: Diagnosis, causes: Dict[str, int],
+        ctx: DiagnosisContext,
+    ):
+        """Attach classification, measured durations and the evidence
+        excerpt — what makes the verdict actionable and what the
+        timeline's loss attribution uses as REAL claim windows."""
+        now = time.time()
+        if verdict.hung:
+            verdict.verdict = "hung"
+            sm = ctx.speed_monitor
+            if sm is not None and getattr(sm, "last_step_time", 0):
+                verdict.stall_s = max(
+                    0.0, now - sm.last_step_time
+                )
+            for _node, (ts, payload) in (
+                self._hang_evidence.items()
+            ):
+                if now - ts > ctx.evidence_window:
+                    continue
+                verdict.stall_s = max(
+                    verdict.stall_s,
+                    float(payload.get("stall_s", 0.0) or 0.0),
+                )
+            verdict.duration_s = verdict.stall_s
+        elif "straggler" in causes:
+            verdict.verdict = "straggler"
+            stats = self.straggler_stats()
+            if stats is not None:
+                _worst_id, worst, med, n = stats
+                # measured excess: the straggler's slowdown over the
+                # fleet median across its windowed samples
+                verdict.duration_s = max(0.0, (worst - med) * n)
+        elif "data_starved" in causes:
+            verdict.verdict = "data_starved"
+        culprit = verdict.culprit_node
+        # evidence excerpt: the culprit's hang flight data first,
+        # any node's as fallback, then the latest plain stack report
+        source = self._hang_evidence.get(culprit)
+        if source is None and self._hang_evidence:
+            source = next(iter(self._hang_evidence.values()))
+        if source is not None:
+            _ts, payload = source
+            verdict.evidence = (
+                (payload.get("workers") or "")
+                + "\n" + (payload.get("stacks") or "")
+            ).strip()[: self._EVIDENCE_EXCERPT]
+        elif culprit >= 0:
+            stacks = [
+                d for d in self._data.get(culprit, [])
+                if d.data_type == "stack"
+            ]
+            if stacks:
+                verdict.evidence = (
+                    stacks[-1].content[: self._EVIDENCE_EXCERPT]
+                )
+        # hung-vs-dead: a culprit whose agent still heartbeats is
+        # HUNG (stuck process, live supervisor — restart it); a
+        # silent one is dead-node territory the heartbeat monitor
+        # owns.  The distinction rides the verdict for the operator.
+        jm = ctx.job_manager
+        if verdict.hung and jm is not None and culprit >= 0:
+            node = jm.get_node(culprit)
+            beat = getattr(node, "heartbeat_time", 0) if node else 0
+            if beat and now - beat < 60.0:
+                verdict.evidence = (
+                    "[agent heartbeat live: trainer hung, node "
+                    "alive]\n" + verdict.evidence
+                )[: self._EVIDENCE_EXCERPT]
+
+    _BLOCKING_KEYWORDS = (
+        "wchan=futex", "barrier", "allreduce", "all_gather",
+        "all_reduce", "psum", "collective", "recv", "state=d",
+    )
+
     def _find_stuck_node(self) -> int:
-        """Heuristic: the node whose latest stack shows a blocking
-        syscall/collective wait while peers progress."""
-        suspects: List[Tuple[int, int]] = []
+        """Heuristic: the node whose hang flight data / latest stack
+        shows a blocking syscall or collective wait while peers
+        progress.  A node that shipped hang evidence at all starts
+        with a base score — its agent *measured* no progress locally,
+        which outranks a merely quiet peer."""
+        suspects: List[Tuple[int, float, int]] = []
+        for node_id, (_ts, payload) in self._hang_evidence.items():
+            content = (
+                (payload.get("stacks") or "")
+                + (payload.get("workers") or "")
+            ).lower()
+            score = 1 + sum(
+                kw in content for kw in self._BLOCKING_KEYWORDS
+            )
+            # fresher evidence with a longer measured stall wins ties
+            stall = float(payload.get("stall_s", 0.0) or 0.0)
+            suspects.append((score, stall, node_id))
         for node_id, datas in self._data.items():
             stacks = [d for d in datas if d.data_type == "stack"]
             if not stacks:
                 continue
             content = stacks[-1].content.lower()
             score = sum(
-                kw in content
-                for kw in ("wchan=futex", "barrier", "allreduce",
-                           "all_gather", "recv", "state=d")
+                kw in content for kw in self._BLOCKING_KEYWORDS
             )
-            suspects.append((score, node_id))
+            suspects.append((score, 0.0, node_id))
         if not suspects:
             return -1
         suspects.sort(reverse=True)
-        return suspects[0][1] if suspects[0][0] > 0 else -1
+        return suspects[0][2] if suspects[0][0] > 0 else -1

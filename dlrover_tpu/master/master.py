@@ -20,8 +20,10 @@ from dlrover_tpu.common.comm import MessageServer, find_free_port
 from dlrover_tpu.common.constants import (
     ErrorMonitorConstants,
     JobExitReason,
+    MasterAction,
     RendezvousName,
 )
+from dlrover_tpu.common.env_utils import _get_float as _env_float
 from dlrover_tpu.common.global_context import Context
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.diagnosis import DiagnosisManager
@@ -42,8 +44,12 @@ from dlrover_tpu.telemetry.exporter import (
     METRICS_PORT_ENV,
     PrometheusEndpoint,
 )
+from dlrover_tpu.telemetry.gcp_monitoring import (
+    maybe_from_env as gcp_from_env,
+)
 from dlrover_tpu.telemetry.metrics import get_registry
 from dlrover_tpu.telemetry.otlp import maybe_from_env as otlp_from_env
+from dlrover_tpu.telemetry.slo import SloChecker
 
 _RECOVERIES_TOTAL = get_registry().counter(
     "dlrover_master_recoveries_total",
@@ -72,6 +78,21 @@ class JobMaster:
         self.speed_monitor = SpeedMonitor()
         self.diagnosis_manager = DiagnosisManager()
         self._last_straggler_warned = -1
+        # hang-verdict restart budget per culprit node: beyond it the
+        # hang escalates to the job-abort path (a node that hangs
+        # every incarnation is broken, not unlucky)
+        self._hang_restarts: dict = {}
+        # consecutive hung polls with NO identified culprit: the
+        # silence rule can fire a beat before the agents' stack
+        # evidence arrives, and aborting the whole job in that beat
+        # would waste the targeted-restart machinery — give the
+        # evidence a few polls to land before escalating
+        self._culpritless_hangs = 0
+        # control-plane latency SLOs evaluated every poll over the
+        # per-verb dlrover_rpc_seconds histograms; breaches surface
+        # as gauges on /metrics + rpc_slo_breach events in the
+        # incident report
+        self.slo_checker = SloChecker()
         # platform-backed masters inject a DistributedJobManager
         # (node watching/scaling); local mode uses the plain one
         self.job_manager = job_manager or JobManager()
@@ -184,6 +205,12 @@ class JobMaster:
         otlp = otlp_from_env(service_name="dlrover_tpu.master")
         if otlp is not None:
             self.aux_services.append(otlp)
+        # GCP-native sink behind the same interfaces (Cloud
+        # Monitoring metrics + Cloud Trace spans) when
+        # DLROVER_GCP_PROJECT is set; can run alongside OTLP
+        gcp = gcp_from_env()
+        if gcp is not None:
+            self.aux_services.append(gcp)
         self._stop = threading.Event()
         self._exit_code = 0
         self._run_thread: Optional[threading.Thread] = None
@@ -290,27 +317,32 @@ class JobMaster:
                         )
                         self._exit_code = 1
                     break
+                # control-plane SLOs: hold the per-verb RPC latency
+                # histograms to their declared bounds every poll
+                try:
+                    self.slo_checker.check()
+                except Exception:  # noqa: BLE001 - policing must
+                    logger.exception("SLO check failed")  # not kill
                 # inference-chain diagnosis over the agents' reported
-                # evidence (stacks, logs, per-node step times) — the
-                # hang verdict replaces the blunt last-step check
-                # with a reasoned one (culprit + action), and a
-                # straggler conclusion is surfaced even while steps
-                # still complete
+                # evidence (stacks, hang flight data, per-node step
+                # times, step-phase breakdowns) — the hang verdict
+                # replaces the blunt last-step check with a reasoned
+                # one (culprit + action + measured durations), and
+                # straggler/data-starved conclusions are surfaced
+                # even while steps still complete
                 for rec in self.servicer.drain_diagnosis_records():
                     self.diagnosis_manager.collect(rec)
                 verdict = self.diagnosis_manager.diagnose(
-                    self.speed_monitor, hang_timeout=ctx.hang_timeout
+                    self.speed_monitor,
+                    hang_timeout=ctx.hang_timeout,
+                    straggler_ratio=ctx.straggler_factor,
+                    job_manager=self.job_manager,
                 )
                 if verdict.hung:
-                    logger.error(
-                        "training hung; stopping job (%s)",
-                        verdict.reason,
-                    )
-                    self.job_manager.job_exit_reason = (
-                        JobExitReason.HANG_ERROR
-                    )
-                    self._exit_code = 1
-                    break
+                    if not self._handle_hang(verdict):
+                        break
+                else:
+                    self._culpritless_hangs = 0
                 if (verdict.action
                         == ErrorMonitorConstants.ACTION_ISOLATE
                         and verdict.culprit_node
@@ -342,6 +374,70 @@ class JobMaster:
                 recoveries=self.recoveries,
             )
         return self._exit_code
+
+    def _handle_hang(self, verdict) -> bool:
+        """Act on a hung verdict.  Returns True when the job should
+        keep running (culprit-only restart requested), False when the
+        hang escalates to a job abort.
+
+        The restart rides the existing relaunch machinery: the
+        master queues ``restart_workers`` on the culprit's next
+        heartbeat ack and the agent supervising the hung trainer
+        executes it — healthy nodes never restart.  The silence
+        clock and the culprit's evidence are reset so the fresh
+        incarnation gets a full hang window before it can be
+        re-convicted; a node that exhausts its restart budget
+        escalates to the abort path."""
+        ctx = Context.instance()
+        culprit = verdict.culprit_node
+        budget = ctx.relaunch_on_worker_failure
+        if culprit < 0 and self._culpritless_hangs < 3:
+            self._culpritless_hangs += 1
+            logger.warning(
+                "training hung but no culprit identified yet "
+                "(%s/3); waiting one poll for agent hang evidence",
+                self._culpritless_hangs,
+            )
+            return True
+        if culprit >= 0 and self._hang_restarts.get(
+            culprit, 0
+        ) < budget:
+            self._culpritless_hangs = 0
+            self._hang_restarts[culprit] = (
+                self._hang_restarts.get(culprit, 0) + 1
+            )
+            logger.error(
+                "training hung (%s); restarting culprit node %s "
+                "only (hang restart %s/%s, stall %.1fs)",
+                verdict.reason, culprit,
+                self._hang_restarts[culprit], budget,
+                verdict.stall_s,
+            )
+            self.servicer.request_node_action(
+                culprit, MasterAction.RESTART_WORKERS
+            )
+            # fresh windows: the recovering trainer must not be
+            # re-diagnosed from pre-restart silence/evidence, and the
+            # recovery itself (heartbeat pickup + respawn + restore +
+            # retrace) needs a grace period a small hang_timeout
+            # cannot provide — a cold restart alone can exceed it
+            self.speed_monitor.note_recovery_action()
+            self.diagnosis_manager.clear_node(culprit)
+            grace = _env_float(
+                "DLROVER_HANG_RESTART_GRACE_S",
+                max(60.0, ctx.hang_timeout),
+            )
+            self.diagnosis_manager.suppress_hang(grace)
+            return True
+        logger.error(
+            "training hung with %s; stopping job (%s)",
+            "no identified culprit" if culprit < 0
+            else f"node {culprit}'s restart budget exhausted",
+            verdict.reason,
+        )
+        self.job_manager.job_exit_reason = JobExitReason.HANG_ERROR
+        self._exit_code = 1
+        return False
 
     def run_in_thread(self):
         self._run_thread = threading.Thread(

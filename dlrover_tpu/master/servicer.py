@@ -26,6 +26,18 @@ from dlrover_tpu.master.rdzv_manager import (
 )
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
+from dlrover_tpu.telemetry.metrics import get_registry
+
+# control-plane SLO raw material: every dispatched request is timed
+# by verb ("get.<MessageType>" / "report.<MessageType>") so the SLO
+# checker can hold the servicer paths to declarative latency bounds
+# — the fleet-scale load harness (ROADMAP item 4) measures against
+# exactly these series
+_RPC_SECONDS = get_registry().histogram(
+    "dlrover_rpc_seconds",
+    "Master servicer dispatch latency by verb "
+    "(verb.MessageType, handler execution only)",
+)
 
 
 class MasterServicer(RequestHandler):
@@ -53,6 +65,15 @@ class MasterServicer(RequestHandler):
         self.journal = None
         self.incarnation = ""
         self.recoveries = 0
+        # per-node actions the master piggybacks on the next heartbeat
+        # ack (diagnosis chain's culprit-only relaunch); one pending
+        # action per node, latest wins
+        self._node_actions: Dict[int, str] = {}
+
+    def request_node_action(self, node_id: int, action: str):
+        """Queue ``action`` for delivery on node ``node_id``'s next
+        heartbeat (the agent consumes it from the ack)."""
+        self._node_actions[int(node_id)] = action
 
     def _jot(self, kind: str, data: Dict):
         if self.journal is not None:
@@ -71,6 +92,12 @@ class MasterServicer(RequestHandler):
     # ------------------------------------------------------------------
 
     def get(self, node_id: int, node_type: str, message):
+        with _RPC_SECONDS.time(
+            verb=f"get.{type(message).__name__}"
+        ):
+            return self._dispatch_get(node_id, node_type, message)
+
+    def _dispatch_get(self, node_id: int, node_type: str, message):
         if isinstance(message, msg.JoinRendezvousRequest):
             mngr = self._rdzv_managers[
                 message.rdzv_name or RendezvousName.ELASTIC_TRAINING
@@ -174,7 +201,11 @@ class MasterServicer(RequestHandler):
             self._job_manager.collect_heartbeat(
                 message.node_id, message.timestamp
             )
-            return msg.HeartbeatResponse()
+            # piggyback a pending action (e.g. the hang diagnosis'
+            # culprit-only restart) on the ack — delivered once
+            return msg.HeartbeatResponse(
+                action=self._node_actions.pop(message.node_id, "")
+            )
 
         if isinstance(message, msg.NodeFailure):
             return msg.BaseResponse(
@@ -204,6 +235,14 @@ class MasterServicer(RequestHandler):
     # ------------------------------------------------------------------
 
     def report(self, node_id: int, node_type: str, message) -> bool:
+        with _RPC_SECONDS.time(
+            verb=f"report.{type(message).__name__}"
+        ):
+            return self._dispatch_report(node_id, node_type, message)
+
+    def _dispatch_report(
+        self, node_id: int, node_type: str, message
+    ) -> bool:
         if isinstance(message, msg.DatasetShardParams):
             self._task_manager.new_dataset(message)
             if message.batch_size:

@@ -140,6 +140,14 @@ class SpeedMonitor:
         with self._lock:
             return self._last_step_time
 
+    def note_recovery_action(self):
+        """The master just acted on a hang verdict (culprit restart):
+        reset the silence clock so the recovering trainer gets one
+        full hang window to produce a step before it can be
+        re-convicted."""
+        with self._lock:
+            self._last_step_time = time.time()
+
     def _running_speed_locked(self) -> float:
         if len(self._samples) < 2:
             return 0.0

@@ -18,6 +18,8 @@ the file to ``<path>.1`` when it exceeds ``max_bytes``.
 """
 
 import glob as _glob
+import heapq
+import itertools
 import json
 import os
 import threading
@@ -194,13 +196,10 @@ def _with_backups(path: str) -> List[str]:
     return backups[::-1] + [path]
 
 
-def collect_events(sources: Iterable[str]) -> List[Dict]:
-    """Merge event logs from ``sources`` (file paths and/or glob
-    patterns, each folded with its rotated backups) into one stream
-    ordered by emission timestamp — the ingestion step of timeline
-    assembly.  Missing files are skipped; records without a numeric
-    ``ts`` sort first (schema guards upstream make them rare)."""
-    merged: List[Dict] = []
+def _resolve_sources(sources: Iterable[str]) -> List[List[str]]:
+    """Expand globs + rotated backups into per-base path chains
+    (oldest backup first), deduplicating overlapping paths."""
+    chains: List[List[str]] = []
     seen: set = set()
     for src in sources:
         if not src:
@@ -209,20 +208,84 @@ def collect_events(sources: Iterable[str]) -> List[Dict]:
             sorted(_glob.glob(src)) if _glob.has_magic(src) else [src]
         )
         for base in paths:
+            chain = []
             for path in _with_backups(base):
                 real = os.path.realpath(path)
                 if real in seen:  # a glob overlapping an explicit path
                     continue
                 seen.add(real)
-                try:
-                    merged.extend(read_events(path))
-                except OSError:
-                    continue
-    def _ts(e: Dict) -> float:
-        ts = e.get("ts")
-        return ts if isinstance(ts, (int, float)) else 0.0
-    merged.sort(key=_ts)
+                chain.append(path)
+            if chain:
+                chains.append(chain)
+    return chains
+
+
+def _event_ts(e: Dict) -> float:
+    ts = e.get("ts")
+    return ts if isinstance(ts, (int, float)) else 0.0
+
+
+def collect_events(sources: Iterable[str]) -> List[Dict]:
+    """Merge event logs from ``sources`` (file paths and/or glob
+    patterns, each folded with its rotated backups) into one stream
+    ordered by emission timestamp — the ingestion step of timeline
+    assembly.  Missing files are skipped; records without a numeric
+    ``ts`` sort first (schema guards upstream make them rare)."""
+    merged: List[Dict] = []
+    for chain in _resolve_sources(sources):
+        for path in chain:
+            try:
+                merged.extend(read_events(path))
+            except OSError:
+                continue
+    merged.sort(key=_event_ts)
     return merged
+
+
+def _chain_events(paths: List[str]) -> Iterator[Dict]:
+    for path in paths:
+        try:
+            yield from read_events(path)
+        except OSError:
+            continue
+
+
+def _locally_sorted(
+    it: Iterator[Dict], window: int
+) -> Iterator[Dict]:
+    """Sort a nearly-ordered stream with a bounded min-heap: one
+    process appends its events chronologically, but concurrent
+    writers to a shared log interleave whole lines slightly out of
+    order — a ``window``-record buffer absorbs that without loading
+    the file."""
+    heap: list = []
+    counter = itertools.count()  # tie-break: dicts don't compare
+    for rec in it:
+        heapq.heappush(heap, (_event_ts(rec), next(counter), rec))
+        if len(heap) > window:
+            yield heapq.heappop(heap)[2]
+    while heap:
+        yield heapq.heappop(heap)[2]
+
+
+def iter_collect_events(
+    sources: Iterable[str], reorder_window: int = 1024
+) -> Iterator[Dict]:
+    """Streaming counterpart of :func:`collect_events`: a k-way heap
+    merge over the per-log streams, each read lazily and locally
+    reordered within ``reorder_window`` records.  Peak memory is
+    ``O(reorder_window x logs)`` regardless of log size — the
+    ingestion mode for multi-day jobs whose event history does not
+    fit in memory (the windowed timeline assembly builds on it).
+    Ordering matches ``collect_events`` as long as any out-of-order
+    distance within one log stays under the window (writers append
+    within milliseconds of ``time.time()``, so in practice a handful
+    of records)."""
+    streams = [
+        _locally_sorted(_chain_events(chain), reorder_window)
+        for chain in _resolve_sources(sources)
+    ]
+    return heapq.merge(*streams, key=_event_ts)
 
 
 _default_exporter: Optional[TrainingEventExporter] = None

@@ -89,6 +89,10 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         # -- trainer -------------------------------------------------
         _s("train_step", ["step", "restart_count", "node_rank"]),
         _s("loss_spike", ["step", "loss", "ema", "factor"]),
+        # per-step phase breakdown from the always-on profiler
+        # (open dict: data_wait / h2d / compute / checkpoint /
+        # report / other_s / total_s, arbitrary user phases allowed)
+        _s("step_phases", ["step", "node_rank"], allow_extra=True),
         # -- checkpoint (open phase dicts: stage timings vary) -------
         _s("checkpoint_shm_save", ["step", "rank"],
            allow_extra=True),
@@ -104,7 +108,21 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         _s("node_check", ["round", "elapsed_s", "world_size"]),
         # -- diagnosis / chaos ---------------------------------------
         _s("diagnosis_verdict",
-           ["hung", "action", "culprit_node", "reason"]),
+           ["hung", "action", "culprit_node", "reason"],
+           # actionable-verdict fields (PR 6): classification,
+           # measured stall/excess durations (the timeline's real
+           # claim windows) and the evidence excerpt
+           ["verdict", "stall_s", "duration_s", "evidence"]),
+        # agent watchdog hang flight data: measured stall + captured
+        # stacks + /proc state of the worker tree
+        _s("hang_evidence",
+           ["node_rank", "stall_s", "last_step"],
+           ["stacks", "workers"]),
+        # control-plane SLO breach onset (per-verb RPC latency
+        # quantile over its declared bound)
+        _s("rpc_slo_breach",
+           ["verb", "quantile", "threshold_s", "observed_s"],
+           ["count"]),
         _s("chaos_inject", [
             "scenario", "seed", "seq", "point", "rule", "action",
             "step", "node_rank",

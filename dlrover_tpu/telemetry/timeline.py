@@ -48,6 +48,7 @@ from dlrover_tpu.telemetry.events import (
     EVENTS_AGGREGATE_ENV,
     collect_events,
     emit_event,
+    iter_collect_events,
 )
 from dlrover_tpu.telemetry.metrics import get_registry
 
@@ -55,12 +56,13 @@ from dlrover_tpu.telemetry.metrics import get_registry
 # lost interval, the more specific cause wins the overlap
 CAUSE_RESTORE = "restore"
 CAUSE_MASTER_RECOVERY = "master_recovery"
+CAUSE_HANG = "hang"
 CAUSE_RENDEZVOUS = "rendezvous"
 CAUSE_STRAGGLER = "straggler"
 CAUSE_UNATTRIBUTED = "unattributed"
 CAUSE_PRIORITY = (
-    CAUSE_RESTORE, CAUSE_MASTER_RECOVERY, CAUSE_RENDEZVOUS,
-    CAUSE_STRAGGLER,
+    CAUSE_RESTORE, CAUSE_MASTER_RECOVERY, CAUSE_HANG,
+    CAUSE_RENDEZVOUS, CAUSE_STRAGGLER,
 )
 
 # span name -> cause category for span-derived slices
@@ -149,10 +151,9 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
         if etype == "train_step":
             steps.setdefault(track, []).append(ts)
             continue
-        if etype == "chaos_inject":
-            tl.instants.append(e)
-            continue
-        if etype == "loss_spike":
+        if etype in ("chaos_inject", "loss_spike",
+                     "diagnosis_verdict", "hang_evidence",
+                     "rpc_slo_breach"):
             tl.instants.append(e)
             continue
         if etype == "span":
@@ -333,6 +334,46 @@ def _assemble_shard_leases(ev: List[Dict], tl: JobTimeline):
             ))
 
 
+def assemble_windows(
+    sources,
+    window_s: float = 3600.0,
+    reorder_window: int = 1024,
+) -> "Iterable[Tuple[float, JobTimeline]]":
+    """Windowed assembly for multi-day logs: stream the merged event
+    logs (:func:`~dlrover_tpu.telemetry.events.iter_collect_events`)
+    and yield ``(window_start_ts, JobTimeline)`` per ``window_s``
+    chunk — peak memory is one window's events, never the whole
+    history.
+
+    ``sources`` is a list of paths/globs, or any iterator of event
+    dicts (already ts-ordered).  Pairings that span a window boundary
+    (a restart recovering in the next window, an unacked shard lease)
+    degrade to open-ended slices inside their window — the price of
+    bounded memory; pick ``window_s`` well above the longest recovery
+    you care about."""
+    if hasattr(sources, "__next__"):
+        it = sources
+    elif sources and isinstance(next(iter(sources), None), dict):
+        it = iter(sources)
+    else:
+        it = iter_collect_events(
+            sources, reorder_window=reorder_window
+        )
+    buf: List[Dict] = []
+    w_start: Optional[float] = None
+    for e in it:
+        ts = _num(e.get("ts"))
+        if w_start is None:
+            w_start = ts
+        if ts - w_start >= window_s and buf:
+            yield w_start, assemble(buf)
+            buf = []
+            w_start = ts
+        buf.append(e)
+    if buf:
+        yield w_start or 0.0, assemble(buf)
+
+
 # -- interval arithmetic (attribution) -------------------------------------
 
 
@@ -427,20 +468,32 @@ def attribute_goodput_loss(tl: JobTimeline) -> Dict:
     out["goodput"] = round(
         _total(training) / (w1 - w0), 4
     ) if w1 > w0 else 1.0
-    # straggler witness: slow-step chaos injections and straggler
-    # diagnosis verdicts have no recorded duration; give each a
-    # nominal claim window ending at the instant (bounded by the
-    # median-derived cutoff the gap rule used)
+    # straggler/hang witnesses carry MEASURED durations now: the
+    # verdict's duration_s (excess time for a straggler, stall for a
+    # hang) and the agent watchdog's stall_s give real claim windows
+    # ending at the event; a legacy verdict/injection without a
+    # duration falls back to a nominal 1 s
     straggler_iv = []
+    hang_iv = []
     for e in tl.events:
-        if (
-            e.get("type") == "diagnosis_verdict"
-            and e.get("action") == "isolate"
-        ) or (
-            e.get("type") == "chaos_inject"
-            and e.get("action") == "slow"
+        etype = e.get("type")
+        ts = _num(e.get("ts"))
+        if etype == "diagnosis_verdict":
+            dur = _num(e.get("duration_s")) or _num(
+                e.get("stall_s")
+            )
+            if e.get("hung"):
+                if dur > 0:
+                    hang_iv.append((ts - dur, ts))
+            elif e.get("action") == "isolate":
+                straggler_iv.append((ts - (dur or 1.0), ts))
+        elif etype == "hang_evidence":
+            stall = _num(e.get("stall_s"))
+            if stall > 0:
+                hang_iv.append((ts - stall, ts))
+        elif (
+            etype == "chaos_inject" and e.get("action") == "slow"
         ):
-            ts = _num(e.get("ts"))
             straggler_iv.append((ts - 1.0, ts))
     cause_iv = {
         CAUSE_RESTORE: [
@@ -450,6 +503,7 @@ def attribute_goodput_loss(tl: JobTimeline) -> Dict:
             (s.start, s.end)
             for s in tl.slices_by_cat(CAUSE_MASTER_RECOVERY)
         ],
+        CAUSE_HANG: hang_iv,
         CAUSE_RENDEZVOUS: [
             (s.start, s.end)
             for s in tl.slices_by_cat(CAUSE_RENDEZVOUS)
@@ -494,6 +548,34 @@ def publish_attribution(attr: Dict, registry=None) -> None:
 
 
 # -- renderers -------------------------------------------------------------
+
+
+def _describe_instant(e: Dict) -> str:
+    """One-line description of an instant event for both renderers."""
+    etype = e.get("type")
+    if etype == "chaos_inject":
+        return (
+            f"{e.get('action')}@{e.get('point')} step={e.get('step')}"
+        )
+    if etype == "diagnosis_verdict":
+        kind = e.get("verdict") or e.get("action")
+        out = f"verdict={kind} culprit={e.get('culprit_node')}"
+        stall = e.get("stall_s") or e.get("duration_s")
+        if isinstance(stall, (int, float)) and stall > 0:
+            out += f" {stall:.1f}s"
+        return out
+    if etype == "hang_evidence":
+        return (
+            f"stall={_num(e.get('stall_s')):.1f}s "
+            f"last_step={e.get('last_step')}"
+        )
+    if etype == "rpc_slo_breach":
+        return (
+            f"{e.get('verb')} {e.get('quantile')}="
+            f"{_num(e.get('observed_s')):.3f}s > "
+            f"{_num(e.get('threshold_s')):.3f}s"
+        )
+    return f"step={e.get('step')}"
 
 
 def to_chrome_trace(
@@ -550,7 +632,7 @@ def to_chrome_trace(
             "name": name, "cat": str(e.get("type")), "ph": "i",
             "ts": us(_num(e.get("ts"))), "pid": pid(_track_of(e)),
             "tid": 0, "s": "g",
-            "args": {"step": e.get("step")},
+            "args": {"detail": _describe_instant(e)},
         })
     for track, p in tracks.items():
         trace_events.append({
@@ -602,6 +684,14 @@ def to_report(
     for cause, seconds in attribution["buckets"].items():
         pct = (100.0 * seconds / loss) if loss > 0 else 0.0
         lines.append(f"  {cause:<16} {seconds:8.3f}s  {pct:5.1f}%")
+    slo_breaches = [
+        e for e in tl.instants if e.get("type") == "rpc_slo_breach"
+    ]
+    if slo_breaches:
+        lines.append("rpc SLO breach onsets:")
+        lines.extend(
+            "  " + _describe_instant(e) for e in slo_breaches
+        )
     lines.append("incidents:")
     incidents = [
         (s.start, f"[{s.cat}] {s.track}: {s.name} "
@@ -610,12 +700,7 @@ def to_report(
     ] + [
         (_num(e.get("ts")),
          f"[{e.get('type')}] {_track_of(e)}: "
-         + (
-             f"{e.get('action')}@{e.get('point')} "
-             f"step={e.get('step')}"
-             if e.get("type") == "chaos_inject"
-             else f"step={e.get('step')}"
-         ))
+         + _describe_instant(e))
         for e in tl.instants
     ]
     for _ts, line in sorted(incidents, key=lambda x: x[0]):

@@ -17,6 +17,7 @@ the data axes; XLA inserts the gradient psum.
 import json
 import os
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -45,6 +46,88 @@ _GRAD_ACCUM_GAUGE = _REG.gauge(
     "dlrover_trainer_grad_accum",
     "Gradient-accumulation factor keeping the global batch fixed",
 )
+_STEP_PHASE_SECONDS = _REG.histogram(
+    "dlrover_step_phase_seconds",
+    "Per-step wall time by phase (data_wait / h2d / compute / "
+    "checkpoint / report / other)",
+)
+
+
+class StepPhaseProfiler:
+    """Always-on phase breakdown of one training step.
+
+    The diagnosis layer needs to tell a *data-starved* trainer (input
+    pipeline dominates) from a *slow* one (compute dominates) from a
+    *hung* one (nothing progresses), which requires real per-phase
+    durations — a bare step time cannot distinguish them.  Cost per
+    phase is two ``perf_counter`` reads and a dict add (~1 µs), so
+    this stays on in production; the event emission is a no-op unless
+    an event log is configured.
+
+    The canonical phases are ``data_wait`` (blocking on the input
+    pipeline), ``h2d`` (host-to-device transfer), ``compute`` (the
+    jitted step — bracket with :meth:`PhaseHandle.block` so async
+    dispatch doesn't leak compute time into the next data wait),
+    ``checkpoint`` and ``report``; arbitrary names are accepted.
+    Un-profiled remainder of the step lands in ``other``.
+    """
+
+    KNOWN_PHASES = (
+        "data_wait", "h2d", "compute", "checkpoint", "report",
+    )
+
+    def __init__(self):
+        self._acc: Dict[str, float] = {}
+        self._step_started = time.perf_counter()
+
+    @contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        handle = PhaseHandle()
+        try:
+            yield handle
+        finally:
+            if handle.pending is not None:
+                try:
+                    jax.block_until_ready(handle.pending)
+                except Exception:  # noqa: BLE001 - profiling must
+                    pass  # never break the step it measures
+            dt = time.perf_counter() - start
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+
+    def add(self, name: str, seconds: float):
+        """Record an externally-timed phase (e.g. the checkpoint
+        engine's own stall measurement)."""
+        self._acc[name] = self._acc.get(name, 0.0) + float(seconds)
+
+    def finish_step(self) -> Dict[str, float]:
+        """Close the step: returns ``{phase: seconds, ...,
+        "total_s", "other_s"}`` and resets for the next step."""
+        now = time.perf_counter()
+        total = max(0.0, now - self._step_started)
+        phases = {k: round(v, 6) for k, v in self._acc.items()}
+        profiled = sum(self._acc.values())
+        phases["total_s"] = round(total, 6)
+        phases["other_s"] = round(max(0.0, total - profiled), 6)
+        self._acc.clear()
+        self._step_started = now
+        return phases
+
+
+class PhaseHandle:
+    """Yielded by :meth:`StepPhaseProfiler.phase`; ``block(x)`` marks
+    ``x`` to be ``jax.block_until_ready``-ed before the phase closes,
+    so the recorded duration covers the device work, not just the
+    async dispatch."""
+
+    __slots__ = ("pending",)
+
+    def __init__(self):
+        self.pending = None
+
+    def block(self, x):
+        self.pending = x
+        return x
 
 
 @jax.tree_util.register_dataclass
@@ -173,6 +256,11 @@ class ElasticTrainer:
         )
         self._epoch = 0
         self._restart_count = env_utils.get_restart_count()
+        # always-on step-phase profiler: report_step() closes the
+        # current step's breakdown and ships it (event + histogram +
+        # metrics-file record for the agent's collectors)
+        self.profiler = StepPhaseProfiler()
+        self.last_step_phases: Dict[str, float] = {}
         _GRAD_ACCUM_GAUGE.set(self.grad_accum)
         logger.info(
             "elastic trainer: global_batch=%s micro=%s dp=%s accum=%s",
@@ -185,10 +273,19 @@ class ElasticTrainer:
         """Samples this data-parallel rank consumes per step."""
         return self.micro_batch_size * self.grad_accum
 
+    def profile(self, name: str):
+        """``with trainer.profile("data_wait"): batch = next(it)`` —
+        see :class:`StepPhaseProfiler`.  For the compute phase,
+        ``with trainer.profile("compute") as p: state, m = step(...);
+        p.block(m)`` brackets the device work with
+        ``block_until_ready``."""
+        return self.profiler.phase(name)
+
     def report_step(self, metrics: Optional[Dict[str, float]] = None):
         """Advance the step counter and write the metrics file the
         agent monitor tails (reference: trainer.py report to file +
         monitor/training.py)."""
+        report_start = time.perf_counter()
         self.global_step += 1
         _REPORTED_STEP.set(self.global_step)
         # per-step training event: this is what lets the chaos
@@ -206,10 +303,35 @@ class ElasticTrainer:
         # step N's completion in the log before the process dies; a
         # slow rule stretches the observable step time (straggler)
         _chaos.fire("trainer.step", step=self.global_step)
+        # close the step's phase breakdown: everything since the last
+        # report (minus profiled phases) is "other"; the report path
+        # itself (event + chaos hook) is booked as "report"
+        self.profiler.add(
+            "report", time.perf_counter() - report_start
+        )
+        phases = self.profiler.finish_step()
+        self.last_step_phases = phases
+        for name, seconds in phases.items():
+            if name == "total_s":
+                continue
+            _STEP_PHASE_SECONDS.observe(
+                seconds,
+                phase="other" if name == "other_s" else name,
+            )
+        # dict-build instead of kwargs so a user phase named "step"
+        # can never collide with the envelope fields
+        emit_event("step_phases", **{
+            **phases,
+            "step": self.global_step,
+            "node_rank": env_utils.get_node_rank(),
+        })
         record = {
             "global_step": self.global_step,
             "timestamp": time.time(),
             "epoch": self._epoch,
+            # the agent's StepPhaseCollector ships these to the
+            # master's diagnosis chain (data-starved detection)
+            "phases": phases,
         }
         if metrics:
             record.update(

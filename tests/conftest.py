@@ -54,6 +54,8 @@ def pytest_collection_modifyitems(config, items):
     fixtures)."""
     early_files = (
         "test_telemetry.py", "test_otlp.py", "test_timeline.py",
+        "test_deep_diagnosis.py", "test_gcp_monitoring.py",
+        "test_bench_guard.py",
         "test_chaos.py",
         "test_restore_pipeline.py", "test_master_journal.py",
         # the chaos acceptance e2e runs (worker kill, shm fallback,

@@ -1,0 +1,141 @@
+"""BENCH compact-final-line contract guard (VERDICT r5 #10).
+
+The bench driver keeps only a 2000-byte stdout tail and parses the
+LAST JSON line; three rounds of chip numbers died to oversized final
+lines before the ≤1500-byte scalars-only contract was frozen.  This
+tier-1 guard pins the contract so profiler/diagnosis additions (new
+sections, new headline keys) can never silently bloat it again."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIMIT = 1500
+
+
+@pytest.fixture(scope="module")
+def bench():
+    """Import bench.py as a module (it lives at the repo root, not in
+    the package; import has no side effects — sections only run under
+    __main__)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO_ROOT, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fat_snapshot() -> dict:
+    """A worst-case cumulative snapshot: every headline key present
+    with wide float values, every section erroring AND skipping, so
+    the headline is as fat as it can ever legitimately get."""
+    snap = {
+        "_speedup": 1398.123456,
+        "goodput": {
+            "goodput_pct": 96.789123, "kills_delivered": 5,
+            "churn_lost_s": 123.456789,
+            "phase_breakdown": {"total_lost_s": {"max": 45.678901}},
+        },
+        "llama_train_step": {
+            "seq2048": {"mfu": 0.591234}, "seq4096": {"mfu": 0.541234},
+        },
+        "train_step": {"flash_attention": {"mfu": 0.481234}},
+        "xl_train_step": {"mfu": 0.391234},
+        "flash_ckpt": {
+            "flash_stall_s": 0.012345, "restore_shm_s": 3.971234,
+        },
+        "auto_config": {"searched_vs_hand": 0.9661234},
+        "sparse_kv": {
+            "deepfm_e2e": {
+                "pipelined": {"steps_per_s": 15.123456},
+                "pipeline_speedup": 2.212345,
+            },
+            "host_gather_Mlookups_per_s": 16.312345,
+        },
+        "input_pipeline": {"input_bound_pct": 12.345678},
+        "gqa_attention_kernel": {"seq2048": {"speedup": 1.812345}},
+        "attention_kernel": {"seq8192": {"flash_vs_xla_speedup": 2.9}},
+        "elastic_recovery": {"recovery_s": 3.612345},
+    }
+    # every known section both errors and is skipped — the headline's
+    # lists must survive the worst case
+    sections = [
+        "goodput", "llama_train_step", "train_step", "xl_train_step",
+        "xl_act_offload", "flash_ckpt", "auto_config", "sparse_kv",
+        "input_pipeline", "gqa_attention_kernel", "attention_kernel",
+        "elastic_recovery", "multislice", "sequence_parallel",
+    ]
+    for name in sections:
+        snap[f"{name}_error"] = "boom " * 50
+        snap[f"{name}_note"] = "skipped: over budget"
+    # partial markers
+    for name in ("goodput", "flash_ckpt", "sparse_kv"):
+        snap[name]["partial"] = True
+    return snap
+
+
+def _is_scalar(v) -> bool:
+    return isinstance(v, (int, float, str, bool)) or v is None
+
+
+def test_headline_is_scalars_only_and_bounded(bench):
+    head = bench._headline(_fat_snapshot())
+    for key, val in head.items():
+        if key in ("errors", "skipped", "partial_sections"):
+            assert isinstance(val, list)
+            assert all(isinstance(x, str) for x in val), key
+        else:
+            assert _is_scalar(val), (
+                f"headline key {key!r} is not a scalar: {val!r}"
+            )
+    # the full compact object (head + detail) must fit the contract
+    compact = {
+        "metric": "flash_ckpt_stall_speedup_vs_sync_save",
+        "value": 1398.12,
+        "unit": "x",
+        "vs_baseline": 139.812,
+        "detail": dict(head, partial=True),
+    }
+    line = json.dumps(compact)
+    assert len(line) <= LIMIT, (
+        f"compact line {len(line)}B > {LIMIT}B: {line}"
+    )
+
+
+def test_emit_final_stdout_line_fits_tail(bench, capsys):
+    """Drive the REAL emission path with the fat snapshot: the last
+    stdout line must parse and fit, whatever lands in the detail."""
+    bench._emit(_fat_snapshot(), partial=True)
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "no stdout line emitted"
+    last = out[-1]
+    assert len(last) <= LIMIT
+    doc = json.loads(last)
+    assert doc["metric"] == "flash_ckpt_stall_speedup_vs_sync_save"
+    assert isinstance(doc["detail"], dict)
+    for key, val in doc["detail"].items():
+        if key in ("errors", "skipped", "partial_sections"):
+            assert isinstance(val, list)
+        else:
+            assert _is_scalar(val), key
+
+
+def test_emit_trim_loop_guarantees_fit_under_adversarial_bloat(
+    bench, capsys
+):
+    """Even a pathological snapshot (a future section stuffing huge
+    values into headline-visible paths) is trimmed down to ≤1500
+    bytes — the hard guarantee, not a convention."""
+    snap = _fat_snapshot()
+    # bloat the error list beyond any reasonable size
+    for i in range(60):
+        snap[f"imaginary_section_{i:02d}_error"] = "x"
+    bench._emit(snap, partial=False)
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(last) <= LIMIT
+    json.loads(last)

@@ -219,6 +219,54 @@ def test_master_kill_restart_midround(tmp_path):
     assert doc["otherData"]["master_incarnations"] == 2
 
 
+def test_trainer_hang_detected_and_culprit_restarted(tmp_path):
+    """ISSUE 7 acceptance (tier-1): freeze the trainer mid-step with
+    the stall primitive.  The agent watchdog must capture hang flight
+    data (faulthandler stacks + /proc worker tree) and ship it; the
+    master's inference chain must reach a *hung* verdict carrying the
+    evidence and a measured stall; ONLY the culprit node is restarted
+    (via the heartbeat-action relaunch path), the restored
+    incarnation finishes the budget, and the goodput attribution
+    books the stall under the ``hang`` bucket with real durations."""
+    report = _run(tmp_path, scenarios.trainer_hang_detected(seed=47))
+    assert report.ok, report.summary()
+
+    # exactly one seeded stall, at the chosen step
+    assert len(report.timeline) == 1, report.timeline
+    _seq, point, _rule, action, step = report.timeline[0]
+    assert point == "trainer.step" and action == "stall"
+    assert step == 5
+
+    # flight data: the watchdog captured stacks + worker /proc state
+    evidence = [
+        e for e in report.events if e.get("type") == "hang_evidence"
+    ]
+    assert evidence, "no hang_evidence events"
+    assert any("pid" in (e.get("workers") or "") for e in evidence)
+
+    # the verdict carries the measured stall and the excerpt
+    verdicts = [
+        e for e in report.events
+        if e.get("type") == "diagnosis_verdict" and e.get("hung")
+    ]
+    assert verdicts, "no hung verdict"
+    assert verdicts[0]["stall_s"] > 0
+    assert verdicts[0]["evidence"]
+    assert verdicts[0]["culprit_node"] >= 0
+
+    # attribution: full coverage, hang booked with real durations
+    attr = report.attribution
+    assert attr["loss_s"] > 0
+    assert sum(attr["buckets"].values()) >= 0.9 * attr["loss_s"]
+    assert attr["buckets"]["hang"] > 0, attr["buckets"]
+
+    # the run really finished
+    final_step, shards = read_last_checkpoint(
+        str(tmp_path / "run" / "ckpt")
+    )
+    assert final_step == TOTAL_STEPS and 0 in shards
+
+
 @pytest.mark.slow
 def test_multinode_partition_subset_rejoins(tmp_path):
     """ISSUE 4 satellite: drop RPC for ONE node of a two-agent job

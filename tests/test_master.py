@@ -414,12 +414,20 @@ def test_topology_sorted_rendezvous_world():
 def test_master_loop_diagnoses_hang_with_culprit(local_master):
     """The run loop drains agent diagnosis reports through the
     inference chain: a stalled step timeline + a blocked-collective
-    stack from one node exits with HANG_ERROR and the verdict names
-    the culprit (reference: the master's all_running_node_hanged
-    check upgraded to the diagnosis chain)."""
-    from dlrover_tpu.common.constants import JobExitReason
+    stack from one node makes the master request a CULPRIT-ONLY
+    restart over the culprit's heartbeat ack — the job keeps running
+    instead of aborting (deep-diagnosis upgrade of the old
+    hang-means-abort policy; the abort path now requires an
+    exhausted restart budget, unit-covered in
+    test_deep_diagnosis.py)."""
+    import threading as _threading
+
     from dlrover_tpu.common.global_context import Context
-    from dlrover_tpu.common.messages import DiagnosisData
+    from dlrover_tpu.common.messages import (
+        DiagnosisData,
+        HeartbeatRequest,
+        JobExitRequest,
+    )
 
     master = local_master
     # a worker reported steps long ago, then stalled
@@ -435,17 +443,31 @@ def test_master_loop_diagnoses_hang_with_culprit(local_master):
     old_poll, old_hang = ctx.seconds_to_check_hang, ctx.hang_timeout
     ctx.seconds_to_check_hang = 0.2
     ctx.hang_timeout = 60.0
+    rc_box = {}
+
+    def _run():
+        rc_box["rc"] = master.run()
+
+    thread = _threading.Thread(target=_run, daemon=True)
     try:
-        rc = master.run()
+        thread.start()
+        # the culprit's next heartbeat carries the restart action
+        deadline = time.time() + 10
+        action = ""
+        while time.time() < deadline and not action:
+            action = client.get(
+                HeartbeatRequest(node_id=1)
+            ).action
+            time.sleep(0.05)
+        assert action == "restart_workers"
+        # targeted restart, not an abort: the loop is still running
+        assert thread.is_alive()
+        assert master.job_manager.job_exit_reason == ""
+        assert master._hang_restarts.get(1) == 1
     finally:
         ctx.seconds_to_check_hang = old_poll
         ctx.hang_timeout = old_hang
-    assert rc == 1
-    assert master.job_manager.job_exit_reason == (
-        JobExitReason.HANG_ERROR
-    )
-    # the chain identified the culprit from the reported stack
-    verdict = master.diagnosis_manager.diagnose(
-        master.speed_monitor, hang_timeout=60.0
-    )
-    assert verdict.hung and verdict.culprit_node == 1
+        client.report(JobExitRequest(reason="test-done"))
+        thread.join(timeout=10)
+    assert not thread.is_alive()
+    assert rc_box.get("rc") == 0
