@@ -1404,7 +1404,12 @@ data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
 batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
 
 for i in range(start_step, 5):
-    state, metrics = step_fn(state, batch)
+    with trainer.profile("h2d"):
+        batch = {"x": jnp.asarray(data[:, :-1]),
+                 "y": jnp.asarray(data[:, 1:])}
+    with trainer.profile("compute") as _p:
+        state, metrics = step_fn(state, batch)
+        _p.block(metrics)
     trainer.report_step(metrics)
     ckpt.save_checkpoint(
         trainer.global_step,
@@ -1494,7 +1499,15 @@ progress.write(f"pid {os.getpid()}\n")
 progress.flush()
 _first = True
 for i in range(start_step, 10**9):
-    state, metrics = step_fn(state, batch)
+    # real per-step h2d under the always-on profiler (the built-in
+    # loops previously profiled only data_wait/compute, so the h2d
+    # phase of every step_phases event was structurally zero)
+    with trainer.profile("h2d"):
+        batch = {"x": jnp.asarray(data[:, :-1]),
+                 "y": jnp.asarray(data[:, 1:])}
+    with trainer.profile("compute") as _p:
+        state, metrics = step_fn(state, batch)
+        _p.block(metrics)
     float(metrics["loss"])  # complete the step before reporting it
     if _first:
         _mark("first_step")
@@ -1503,11 +1516,13 @@ for i in range(start_step, 10**9):
     progress.write(f"{time.time()} {i + 1}\n")
     progress.flush()
     if (i + 1) % CKPT_EVERY == 0:
-        ckpt.save_checkpoint(
-            i + 1,
-            {"params": state.params, "trainer": trainer.state_dict()},
-            storage_type=StorageType.MEMORY,
-        )
+        with trainer.profile("checkpoint"):
+            ckpt.save_checkpoint(
+                i + 1,
+                {"params": state.params,
+                 "trainer": trainer.state_dict()},
+                storage_type=StorageType.MEMORY,
+            )
 '''
 
 

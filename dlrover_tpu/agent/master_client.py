@@ -424,3 +424,11 @@ class MasterClient:
     @retry_request
     def report_job_exit(self, reason: str) -> bool:
         return self._client.report(msg.JobExitRequest(reason=reason))
+
+    @retry_request
+    def request_resize(self, target: int, reason: str = "operator") -> bool:
+        """Operator-requested elastic world resize: ask the master's
+        resize coordinator to reconverge the job at ``target`` nodes."""
+        return self._client.report(
+            msg.ResizeRequest(target=target, reason=reason)
+        )

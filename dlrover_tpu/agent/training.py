@@ -213,7 +213,13 @@ class ElasticTrainingAgent:
         self._node_rank = (
             node_rank if node_rank is not None else env_utils.get_node_rank()
         )
+        # _restart_count is the incarnation id (every restart bumps
+        # it — events/env depend on it); _budget_restarts counts only
+        # UNPLANNED restarts (worker failures, hang convictions)
+        # against max_restarts — a planned drain (resize, membership
+        # re-form) must not eat the failure budget
         self._restart_count = 0
+        self._budget_restarts = 0
         self._procs: List[subprocess.Popen] = []
         self._rdzv = MasterRendezvousHandler(
             RendezvousName.ELASTIC_TRAINING,
@@ -462,7 +468,17 @@ class ElasticTrainingAgent:
 
     def _membership_changed(self) -> bool:
         """True when the master has nodes waiting to join/leave and the
-        world should be re-formed (reference: training.py:711)."""
+        world should be re-formed (reference: training.py:711).
+
+        ``DLROVER_MEMBERSHIP_SELF_RESTART=0`` disables this agent-side
+        fallback: when the master's resize coordinator is armed it
+        owns ALL world changes (journaled decision + drained
+        survivors), and N agents each self-restarting on the same
+        waiting signal would thunder-herd the re-form."""
+        if os.getenv(
+            "DLROVER_MEMBERSHIP_SELF_RESTART", "1"
+        ).strip().lower() in ("0", "false", "no", "off"):
+            return False
         try:
             waiting = self._client.num_nodes_waiting(
                 RendezvousName.ELASTIC_TRAINING
@@ -598,22 +614,66 @@ class ElasticTrainingAgent:
         outcome = self._rdzv.next_rendezvous()
         self._start_workers(outcome)
 
-    def _restart_workers(self):
+    def _restart_workers(self, reason: str = "failure"):
         self._restart_count += 1
-        logger.info("restarting workers (restart %s)", self._restart_count)
+        if reason in ("failure", "hang"):
+            self._budget_restarts += 1
+        logger.info(
+            "restarting workers (restart %s, reason %s)",
+            self._restart_count, reason,
+        )
         _RESTARTS_TOTAL.inc()
         emit_event(
             "worker_restart",
             node_rank=self._node_rank,
             restart_count=self._restart_count,
+            reason=reason,
         )
         self._save_ckpt_at_breakpoint()
-        self._stop_workers()
+        if reason == "resize":
+            # drain fast: the old world is DEAD (its collective
+            # partners changed), so a trainer wedged in a doomed
+            # collective gets a short SIGTERM grace, not the full
+            # stop window — XLA's preemption notifier swallows
+            # SIGTERM, so the escalation to SIGKILL is the path that
+            # actually ends it, and every second here is resize
+            # downtime.  The breakpoint save above already persisted
+            # the shm snapshot, so the kill loses nothing.
+            self._stop_workers(
+                timeout=env_utils._get_float(
+                    "DLROVER_RESIZE_STOP_TIMEOUT_S", 5.0
+                )
+            )
+        else:
+            self._stop_workers()
+        # restore prefetch hint (ROADMAP 3b): page the shm checkpoint
+        # segments in WHILE the replacement trainer is still paying
+        # its interpreter/jax import cost — by the time it mmaps the
+        # snapshot, the pages are resident and the restore's
+        # fault-bound term is gone.  Background thread: the page
+        # touches must overlap the spawn, not precede it.
+        self._prefetch_shm_for_restore()
         self._initialize_workers()
         if self._hang_watchdog is not None:
             # the recovery window (respawn + restore + retrace) must
             # not read as a stall of the fresh incarnation
             self._hang_watchdog.reset()
+
+    def _prefetch_shm_for_restore(self):
+        if os.getenv(
+            "DLROVER_RESTORE_PREFETCH", "1"
+        ).strip().lower() in ("0", "false", "no", "off"):
+            return
+        import threading
+
+        from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+        threading.Thread(
+            target=AsyncCheckpointSaver.prefetch_shm_snapshots,
+            kwargs={"restart_count": self._restart_count},
+            daemon=True,
+            name="shm-prefetch",
+        ).start()
 
     def _pop_master_action(self) -> str:
         """Consume the action the master piggybacked on the last
@@ -650,7 +710,7 @@ class ElasticTrainingAgent:
                     "master requested a worker restart (hang "
                     "diagnosis); restarting local workers"
                 )
-                if self._restart_count >= self._spec.max_restarts:
+                if self._budget_restarts >= self._spec.max_restarts:
                     logger.error(
                         "max restarts (%s) exhausted; cannot honor "
                         "master restart request",
@@ -660,7 +720,21 @@ class ElasticTrainingAgent:
                     self._stop_workers()
                     self._client.ready_to_exit("failed")
                     return 1
-                self._restart_workers()
+                self._restart_workers(reason="hang")
+                continue
+            if action == MasterAction.RESIZE:
+                # elastic world-resize: the master decided a new
+                # target world size (capacity loss/gain or operator
+                # request).  A PLANNED drain, not a failure: restart
+                # the local workers into the re-formed world without
+                # burning the failure-restart budget — the breakpoint
+                # save persists the shm snapshot first, and the new
+                # incarnation restores RESHARDED onto the new mesh.
+                logger.warning(
+                    "master requested a world resize; draining local "
+                    "workers and re-joining the rendezvous"
+                )
+                self._restart_workers(reason="resize")
                 continue
             state, codes = self._monitor_workers()
             if state == WorkerState.SUCCEEDED:
@@ -676,7 +750,7 @@ class ElasticTrainingAgent:
                     restart_count=self._restart_count,
                     node_rank=self._node_rank,
                 )
-                if self._restart_count >= self._spec.max_restarts:
+                if self._budget_restarts >= self._spec.max_restarts:
                     logger.error(
                         "max restarts (%s) exhausted; giving up",
                         self._spec.max_restarts,
@@ -685,10 +759,10 @@ class ElasticTrainingAgent:
                     self._stop_workers()
                     self._client.ready_to_exit("failed")
                     return 1
-                self._restart_workers()
+                self._restart_workers(reason="failure")
             elif self._membership_changed():
                 logger.info("membership changed; re-rendezvous")
-                self._restart_workers()
+                self._restart_workers(reason="membership")
 
     def stop(self):
         self._stop_workers()

@@ -94,10 +94,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"in {workdir}"
     )
     nnodes = args.nnodes
-    if nnodes <= 1 and scenario.name == "multinode-rpc-partition":
-        # the subset-partition scenario is meaningless single-node
+    if nnodes <= 1 and scenario.name in (
+        "multinode-rpc-partition", "multinode-hang-culprit",
+        "elastic-resize-churn",
+    ):
+        # the subset-fault scenarios are meaningless single-node
         nnodes = 2
-    if nnodes > 1:
+    if scenario.name == "elastic-resize-churn":
+        # needs the elastic runner: a min_nodes<nnodes master, a
+        # shared checkpoint dir, and the replacement-agent respawn
+        report = harness.run_elastic_resize_scenario(
+            scenario,
+            workdir=workdir,
+            nnodes=nnodes,
+            total_steps=args.steps,
+            max_restarts=args.max_restarts,
+        )
+    elif nnodes > 1:
         report = harness.run_scenario_multinode(
             scenario,
             workdir=workdir,

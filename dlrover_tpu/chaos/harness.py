@@ -38,10 +38,12 @@ from dlrover_tpu.chaos.scenarios import (
     CHAOS_TRAIN_SCRIPT,
     CKPT_EVERY_ENV,
     DISK_EVERY_ENV,
+    RESIZE_TRAIN_SCRIPT,
     RUN_OPTIONS,
     SHARD_DATASET_ENV,
     STEP_SLEEP_ENV,
     TOTAL_STEPS_ENV,
+    resize_reference_losses,
 )
 from dlrover_tpu.chaos.schedule import Scenario, load_scenario
 from dlrover_tpu.common.env_utils import proc_stat_fields
@@ -373,6 +375,234 @@ class OnlyCulpritRestarted(Invariant):
             self.name, True,
             f"{len(restarts)} restart(s), all on culprit node "
             f"{self.culprit_rank}",
+        )
+
+
+class WorldSizeTrajectory(Invariant):
+    """Elastic-resize invariant: the completed-world size actually
+    changed through the expected sequence — e.g. ``[2, 1, 2]`` means
+    the elastic-training rendezvous completed at 2 nodes, later at 1,
+    later at 2 again (extra rounds between are allowed; the FINAL
+    round must match the last expected size)."""
+
+    name = "world_size_trajectory"
+
+    def __init__(self, expected: Sequence[int]):
+        self.expected = list(expected)
+
+    def check(self, events, run):
+        sizes = [
+            len(e.get("nodes") or [])
+            for e in events
+            if e.get("type") == "rendezvous_complete"
+            and e.get("rdzv") == "elastic-training"
+        ]
+        if not sizes:
+            return InvariantResult(
+                self.name, False, "no elastic rendezvous rounds"
+            )
+        want = list(self.expected)
+        i = 0
+        for size in sizes:
+            if i < len(want) and size == want[i]:
+                i += 1
+        if i < len(want):
+            return InvariantResult(
+                self.name, False,
+                f"round sizes {sizes} do not contain the expected "
+                f"trajectory {want} (matched {i}/{len(want)})",
+            )
+        if sizes[-1] != want[-1]:
+            return InvariantResult(
+                self.name, False,
+                f"final world is {sizes[-1]}, expected {want[-1]} "
+                f"(sizes: {sizes})",
+            )
+        return InvariantResult(
+            self.name, True, f"round sizes {sizes} ⊇ {want}"
+        )
+
+
+class LossTrajectoryMatches(Invariant):
+    """Resharded-restore correctness, decided from the event log
+    alone: every reported ``train_step`` loss must equal the
+    uninterrupted-control trajectory at that step (the resize train
+    loop derives its batch from the step index, so the control is a
+    pure recomputation), AND at least one step must carry records
+    from two distinct incarnations/nodes — the proof that replay /
+    cross-node agreement was actually exercised, not vacuously
+    skipped.  A restore that resharded the params wrong diverges at
+    the first replayed step."""
+
+    name = "loss_trajectory_matches_control"
+
+    def __init__(self, expected: Sequence[float],
+                 rtol: float = 1e-3, atol: float = 1e-5):
+        self.expected = list(expected)
+        self.rtol = rtol
+        self.atol = atol
+
+    def check(self, events, run):
+        by_step = {}
+        for e in events:
+            if e.get("type") != "train_step":
+                continue
+            loss = e.get("loss")
+            if not isinstance(loss, (int, float)):
+                continue
+            step = int(e.get("step", 0))
+            by_step.setdefault(step, []).append(
+                (e.get("node_rank"), e.get("restart_count"), loss)
+            )
+        if not by_step:
+            return InvariantResult(
+                self.name, False, "no train_step events carry a loss"
+            )
+        mismatches = []
+        for step, recs in sorted(by_step.items()):
+            if not (1 <= step <= len(self.expected)):
+                mismatches.append(f"step {step} outside control")
+                continue
+            want = self.expected[step - 1]
+            for rank, count, loss in recs:
+                if abs(loss - want) > self.atol + self.rtol * abs(want):
+                    mismatches.append(
+                        f"step {step} node{rank} r{count}: "
+                        f"{loss:.6g} != control {want:.6g}"
+                    )
+        if mismatches:
+            return InvariantResult(
+                self.name, False,
+                f"{len(mismatches)} loss divergence(s): "
+                f"{mismatches[:5]}",
+            )
+        multi = [
+            step for step, recs in by_step.items()
+            if len({(r, c) for r, c, _ in recs}) > 1
+        ]
+        if not multi:
+            return InvariantResult(
+                self.name, False,
+                "no step was reported by more than one incarnation/"
+                "node — the cross-check never ran",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{len(by_step)} step(s) match control "
+            f"({len(multi)} with multi-incarnation agreement)",
+        )
+
+
+class BoundedStepLossPerRestart(Invariant):
+    """Per-restart step loss: for every ``worker_restart`` on node N
+    at incarnation C, the steps lost between incarnation C-1's last
+    step and C's first step stay within one durable-checkpoint
+    interval, and the new incarnation never resumes AHEAD of
+    recorded progress.  (The global first-vs-resumed rule breaks
+    down once a REPLACEMENT node legitimately starts a fresh
+    incarnation-0 process late in the run.)"""
+
+    name = "bounded_step_loss_per_restart"
+
+    def __init__(self, interval: int):
+        self.interval = interval
+
+    def check(self, events, run):
+        steps = {}
+        for e in events:
+            if e.get("type") != "train_step":
+                continue
+            key = (e.get("node_rank"), e.get("restart_count", 0))
+            steps.setdefault(key, []).append(int(e.get("step", 0)))
+        checked = 0
+        problems = []
+        for e in events:
+            if e.get("type") != "worker_restart":
+                continue
+            rank = e.get("node_rank")
+            count = e.get("restart_count")
+            before = steps.get((rank, count - 1))
+            after = steps.get((rank, count))
+            if not before or not after:
+                continue  # an incarnation never stepped: nothing lost
+            lost = max(before) - (min(after) - 1)
+            checked += 1
+            if lost < 0:
+                problems.append(
+                    f"node{rank} r{count} resumed AHEAD "
+                    f"({min(after)} after {max(before)})"
+                )
+            elif lost > self.interval:
+                problems.append(
+                    f"node{rank} r{count} lost {lost} step(s) > "
+                    f"interval {self.interval}"
+                )
+        if problems:
+            return InvariantResult(
+                self.name, False, "; ".join(problems)
+            )
+        if not checked:
+            return InvariantResult(
+                self.name, False,
+                "no restart had steps on both sides to compare",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{checked} restart(s) within interval {self.interval}",
+        )
+
+
+class ResizePhasesOnTimeline(Invariant):
+    """The assembled flight-recorder timeline carries the
+    ``dlrover_resize_seconds`` phase breakdown: per resize decision a
+    ``decide``/``rendezvous``/``first_step`` trail (``drain`` and
+    ``reshard_restore`` where the events exist), rendered as
+    ``resize``-cause slices."""
+
+    name = "resize_phases_on_timeline"
+
+    def __init__(self, min_resizes: int = 1):
+        self.min_resizes = min_resizes
+
+    def check(self, events, run):
+        tl = run.job_timeline
+        if tl is None:
+            tl = flight.assemble(events)
+        slices = tl.slices_by_cat(flight.CAUSE_RESIZE)
+        if not slices:
+            return InvariantResult(
+                self.name, False, "no resize slices on the timeline"
+            )
+        phases = {}
+        for s in slices:
+            phases.setdefault(s.meta.get("phase"), []).append(
+                round(s.duration, 3)
+            )
+        completed = len(phases.get("rendezvous", []))
+        if completed < self.min_resizes:
+            return InvariantResult(
+                self.name, False,
+                f"only {completed} resize(s) reached a completed "
+                f"rendezvous phase (need {self.min_resizes}); "
+                f"phases: {phases}",
+            )
+        missing = {"decide", "rendezvous", "first_step"} - set(phases)
+        if missing:
+            return InvariantResult(
+                self.name, False,
+                f"phase(s) {sorted(missing)} absent from the "
+                f"timeline (have {sorted(phases)})",
+            )
+        if "reshard_restore" not in phases:
+            return InvariantResult(
+                self.name, False,
+                f"no reshard_restore phase on any resize — the "
+                f"re-formed world never restored (phases: {phases})",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{completed} completed resize(s); phase durations "
+            f"{ {k: v for k, v in sorted(phases.items())} }",
         )
 
 
@@ -1376,6 +1606,289 @@ def run_scenario_multinode(
         invariants if invariants is not None
         else default_multinode_invariants(
             nnodes, total_steps, workdir, faulted_rank=faulted_rank
+        )
+    )
+    for inv in checks:
+        try:
+            report.invariants.append(
+                inv.check(report.events, report)
+            )
+        except Exception as e:  # noqa: BLE001 - a checker bug is a FAIL
+            logger.exception("invariant %s crashed", inv.name)
+            report.invariants.append(
+                InvariantResult(inv.name, False, f"checker crashed: {e}")
+            )
+    return report
+
+
+def elastic_resize_invariants(
+    nnodes: int, total_steps: int, disk_every: int, workdir: str,
+    dim: int = 64,
+) -> List[Invariant]:
+    """The elastic-resize acceptance set: the completed world really
+    changed N -> N-1 -> N, the cross-world restores came RESHARDED
+    from the committed storage tier, every reported loss matches the
+    uninterrupted control, per-restart step loss is bounded by the
+    durable interval, dataset shards stay exactly-once, the final
+    step commits, the resize phase breakdown is on the timeline, and
+    the goodput loss is booked under the resize cause."""
+    return [
+        WorldSizeTrajectory([nnodes, nnodes - 1, nnodes]),
+        EventRecorded("resize_decision", min_count=2),
+        RestoredFromTier("storage"),
+        LossTrajectoryMatches(
+            resize_reference_losses(total_steps, dim=dim)
+        ),
+        BoundedStepLossPerRestart(interval=disk_every),
+        NoDuplicateShards(dataset_size=total_steps),
+        FinalStepCommitted(),
+        ResizePhasesOnTimeline(min_resizes=2),
+        GoodputLossAttributed(
+            min_attributed_frac=0.5,
+            expect_cause=flight.CAUSE_RESIZE,
+        ),
+        NoOrphanProcesses(marker=workdir),
+    ]
+
+
+def run_elastic_resize_scenario(
+    scenario,
+    workdir: str,
+    nnodes: int = 2,
+    min_nodes: int = 1,
+    kill_rank: Optional[int] = None,
+    total_steps: Optional[int] = None,
+    disk_every: Optional[int] = None,
+    max_restarts: int = 3,
+    monitor_interval: float = 0.3,
+    invariants: Optional[List[Invariant]] = None,
+    rejoin_after_steps: int = 2,
+    timeout: float = 240.0,
+) -> ChaosRunReport:
+    """Drive the elastic world-resize churn: ``nnodes`` real tpurun
+    agents against a ``min_nodes``-floored master, ALL sharing one
+    checkpoint directory (the shared filesystem that makes cross-host
+    shard redistribution possible).  The scenario's ``kill_node`` rule
+    takes one agent's whole supervision tree down mid-run; the master
+    shrinks the world and the survivor reshards-restores.  Once the
+    shrunken world has made ``rejoin_after_steps`` steps, the harness
+    plays the cluster scheduler and starts a REPLACEMENT agent for the
+    lost rank (fresh shm namespace — a new host — and
+    ``DLROVER_AGENT_RESPAWNED=1`` so seeded rules never re-fire),
+    which grows the world back.  Invariants then decide everything
+    from the telemetry event log."""
+    from dlrover_tpu.common.comm import addr_connected, find_free_port
+
+    scenario = load_scenario(scenario)
+    opts = RUN_OPTIONS.get(scenario.name, {})
+    if total_steps is None:
+        total_steps = int(opts.get("total_steps", 24))
+    if disk_every is None:
+        disk_every = int(opts.get("disk_every", 3))
+    step_sleep = float(opts.get("step_sleep", 0.0))
+    if kill_rank is None:
+        kill_rank = nnodes - 1
+    os.makedirs(workdir, exist_ok=True)
+    spec_path = os.path.join(workdir, "chaos_scenario.json")
+    with open(spec_path, "w") as f:
+        json.dump(scenario.to_dict(), f, indent=2)
+    script = os.path.join(workdir, "resize_train.py")
+    with open(script, "w") as f:
+        f.write(RESIZE_TRAIN_SCRIPT)
+    event_log = os.path.join(workdir, "events.jsonl")
+    agent_event_glob = os.path.join(workdir, "events_node*.jsonl")
+    ckpt_dir = os.path.join(workdir, "ckpt")  # SHARED across nodes
+
+    base_env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        **{
+            _chaos.CHAOS_ENV: spec_path,
+            EVENT_LOG_ENV: event_log,
+            EVENTS_AGGREGATE_ENV: agent_event_glob,
+            TOTAL_STEPS_ENV: str(total_steps),
+            DISK_EVERY_ENV: str(disk_every),
+        },
+    )
+    if step_sleep:
+        base_env[STEP_SLEEP_ENV] = str(step_sleep)
+    if opts.get("shard_dataset"):
+        base_env[SHARD_DATASET_ENV] = str(total_steps)
+    base_env.update(opts.get("extra_env", {}))
+    import dlrover_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(dlrover_tpu.__file__))
+    prev_pp = base_env.get("PYTHONPATH", "")
+    if pkg_root not in prev_pp.split(os.pathsep):
+        base_env["PYTHONPATH"] = (
+            f"{pkg_root}{os.pathsep}{prev_pp}" if prev_pp else pkg_root
+        )
+    port = find_free_port()
+    addr = f"127.0.0.1:{port}"
+    master_env = dict(
+        base_env,
+        DLROVER_MASTER_JOURNAL_DIR=os.path.join(
+            workdir, "master_journal"
+        ),
+        DLROVER_RESTART_COUNT="0",
+    )
+    master = subprocess.Popen(  # noqa: S603
+        [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--port", str(port), "--node_num", str(nnodes),
+            "--min_nodes", str(min_nodes),
+        ],
+        env=master_env,
+    )
+
+    def agent_env(rank: int, respawn: bool) -> Dict[str, str]:
+        # a respawned rank is a REPLACEMENT host: a fresh IPC/shm
+        # namespace (its predecessor's stale shm must not exist on a
+        # new VM) and the respawn marker protecting it from seeded
+        # rules
+        suffix = "b" if respawn else ""
+        env = dict(
+            base_env,
+            DLROVER_MASTER_ADDR=addr,
+            **{EVENT_LOG_ENV: os.path.join(
+                workdir, f"events_node{rank}.jsonl"
+            )},
+            DLROVER_NODE_RANK=str(rank),
+            DLROVER_NODE_ID=str(rank),
+            DLROVER_SHARED_DIR=os.path.join(
+                workdir, f"sock{rank}{suffix}"
+            ),
+            DLROVER_METRICS_FILE=os.path.join(
+                workdir, f"metrics_{rank}{suffix}.json"
+            ),
+        )
+        if respawn:
+            env["DLROVER_AGENT_RESPAWNED"] = "1"
+        return env
+
+    def spawn_agent(rank: int, respawn: bool, logs: List):
+        out = open(
+            os.path.join(
+                workdir,
+                f"agent{rank}{'_respawn' if respawn else ''}.log",
+            ),
+            "w",
+        )
+        logs.append(out)
+        argv = [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--nnodes", f"{min_nodes}:{nnodes}",
+            "--nproc_per_node", "1",
+            f"--max_restarts={max_restarts}",
+            f"--monitor_interval={monitor_interval}",
+            "--node_rank", str(rank),
+            script, ckpt_dir,
+        ]
+        return subprocess.Popen(  # noqa: S603
+            argv, env=agent_env(rank, respawn),
+            stdout=out, stderr=subprocess.STDOUT,
+        )
+
+    def shrunken_world_stepping() -> bool:
+        """The respawn trigger, from the event log alone: the world
+        reconverged at nnodes-1 AND made rejoin_after_steps steps
+        since — replacement capacity arriving mid-recovery would
+        race the shrink and prove nothing."""
+        try:
+            ev = collect_events([
+                event_log,
+                os.path.join(workdir, "events_node*.jsonl"),
+            ])
+        except Exception:  # noqa: BLE001 - torn mid-write reads retry
+            return False
+        round_ts = None
+        for e in ev:
+            if (
+                e.get("type") == "rendezvous_complete"
+                and e.get("rdzv") == "elastic-training"
+                and len(e.get("nodes") or []) == nnodes - 1
+            ):
+                round_ts = e["ts"]
+                break
+        if round_ts is None:
+            return False
+        later_steps = [
+            e for e in ev
+            if e.get("type") == "train_step" and e["ts"] > round_ts
+        ]
+        return len(later_steps) >= rejoin_after_steps
+
+    agents: Dict[int, subprocess.Popen] = {}
+    logs: List = []
+    rc = 0
+    respawned = False
+    try:
+        deadline = time.time() + 30
+        while not addr_connected(addr):
+            if master.poll() is not None or time.time() > deadline:
+                raise RuntimeError("resize master failed to start")
+            time.sleep(0.2)
+        for rank in range(nnodes):
+            agents[rank] = spawn_agent(rank, respawn=False, logs=logs)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            states = {r: p.poll() for r, p in agents.items()}
+            if not respawned and states.get(kill_rank) is not None:
+                if shrunken_world_stepping():
+                    logger.info(
+                        "shrunken world is stepping; respawning "
+                        "replacement agent for rank %s", kill_rank,
+                    )
+                    agents[kill_rank] = spawn_agent(
+                        kill_rank, respawn=True, logs=logs
+                    )
+                    respawned = True
+            elif all(s is not None for s in states.values()):
+                if respawned or states.get(kill_rank) is None:
+                    break
+            time.sleep(0.3)
+        else:
+            rc = 124  # deadline: kill whatever is left
+        for p in agents.values():
+            if p.poll() is None and rc == 124:
+                p.kill()
+            try:
+                p.wait(timeout=max(1.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                rc = rc or 124
+        if not respawned:
+            rc = rc or 125  # the churn never completed its arc
+        for rank, p in agents.items():
+            # the killed rank's FIRST incarnation legitimately dies
+            # non-zero; every final incarnation must succeed
+            rc = rc or (p.returncode or 0)
+    finally:
+        for p in agents.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if master.poll() is None:
+            master.terminate()
+            try:
+                master.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master.kill()
+        for out in logs:
+            try:
+                out.close()
+            except OSError:
+                pass
+
+    report = _build_report(
+        scenario, rc, workdir, event_log,
+        extra_sources=[agent_event_glob],
+    )
+    checks = (
+        invariants if invariants is not None
+        else elastic_resize_invariants(
+            nnodes, total_steps, disk_every, workdir,
         )
     )
     for inv in checks:

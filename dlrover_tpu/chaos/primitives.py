@@ -86,6 +86,27 @@ def act_kill_worker(args: Dict[str, Any], ctx: Dict[str, Any]):
     return kill_process(pid, sig)
 
 
+def act_kill_node(args: Dict[str, Any], ctx: Dict[str, Any]):
+    """Node-loss parity: kill the supervised worker processes from
+    ``ctx['procs']`` FIRST, then the current (agent) process — a VM
+    that disappears takes its whole supervision tree with it, unlike
+    ``kill`` (worker keeps its agent) or ``kill_worker`` (agent keeps
+    supervising).  The elastic-resize scenarios fire this at the
+    ``agent.monitor`` hook so the master sees a node go silent with
+    no failure report, exactly like real capacity loss."""
+    sig = _resolve_signal(args)
+    for proc in ctx.get("procs") or []:
+        pid = getattr(proc, "pid", None)
+        if pid is not None:
+            kill_process(pid, sig)
+    logger.warning(
+        "chaos: node loss — killed worker tree, now signalling own "
+        "pid %s with %s", os.getpid(), sig,
+    )
+    kill_process(os.getpid(), sig)
+    return None
+
+
 def act_drop(args: Dict[str, Any], ctx: Dict[str, Any]):
     raise ChaosRpcError(
         f"chaos: dropped {ctx.get('point', 'rpc')} frame"
@@ -162,6 +183,7 @@ def act_preempt(args: Dict[str, Any], ctx: Dict[str, Any]):
 ACTIONS = {
     "kill": act_kill,
     "kill_worker": act_kill_worker,
+    "kill_node": act_kill_node,
     "drop": act_drop,
     "delay": act_delay,
     "io_error": act_io_error,

@@ -88,7 +88,14 @@ trainer = ElasticTrainer(global_batch_size=8, micro_batch_size=8,
 trainer.global_step = start_step
 rng = np.random.default_rng(0)
 data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
-batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
+
+def place_batch():
+    # per-step host->device placement so the always-on profiler's
+    # h2d phase measures a real transfer, not zero
+    return {"x": jnp.asarray(data[:, :-1]),
+            "y": jnp.asarray(data[:, 1:])}
+
+batch = place_batch()
 
 def after_step():
     # identical checkpoint cadence for both loop flavours
@@ -128,6 +135,8 @@ if SHARD_DATASET:
             task = sc.fetch_task()
         if task is None:
             break
+        with trainer.profile("h2d"):
+            batch = place_batch()
         with trainer.profile("compute") as p:
             state, metrics = step_fn(state, batch)
             p.block(metrics)
@@ -142,9 +151,11 @@ if SHARD_DATASET:
     FINAL_STEP = trainer.global_step
 else:
     for i in range(start_step, TOTAL_STEPS):
-        # the always-on profiler: compute bracketed by
-        # block_until_ready, so every train_step ships a real
-        # step_phases breakdown
+        # the always-on profiler: h2d is a real per-step placement
+        # and compute is bracketed by block_until_ready, so every
+        # train_step ships a real step_phases breakdown
+        with trainer.profile("h2d"):
+            batch = place_batch()
         with trainer.profile("compute") as p:
             state, metrics = step_fn(state, batch)
             p.block(metrics)
@@ -186,6 +197,206 @@ else:
     ckpt.wait()
 ckpt.close()
 '''
+
+
+# Elastic world-resize train loop (ISSUE 8): a GLOBAL param sharded
+# over ALL devices of the current world (2 hosts x 2 CPU devices at
+# world=2, 1 host x 2 at world=1 — the harness exports
+# xla_force_host_platform_device_count=2), trained in lockstep with a
+# real cross-process collective per step via jax.distributed.  Every
+# incarnation re-forms the mesh from the agent's env contract and
+# restores the checkpoint RESHARDED onto it: the storage tier holds
+# per-host shard files, so a 2-host -> 1-host restore genuinely
+# redistributes node 1's shards onto node 0's devices.  The per-step
+# batch is a pure function of the step index (counter-based PRNG), so
+# the loss at step k is identical for ANY world size / restart
+# history — :func:`resize_reference_losses` recomputes the
+# uninterrupted-control trajectory in-process and the harness compares
+# every reported loss against it.  argv: ckpt_dir (SHARED across all
+# nodes — that is what makes cross-host redistribution possible).
+RESIZE_TRAIN_SCRIPT = r'''
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticTrainer, init_jax_distributed,
+)
+
+ckpt_dir = sys.argv[1]
+TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "24"))
+DISK_EVERY = int(os.environ.get("DLROVER_CHAOS_DISK_EVERY", "3"))
+STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
+SHARD_DATASET = int(os.environ.get("DLROVER_CHAOS_SHARD_DATASET", "0"))
+DIM = int(os.environ.get("DLROVER_CHAOS_RESIZE_DIM", "64"))
+
+WORLD = int(os.environ.get("DLROVER_WORLD_SIZE", "1") or 1)
+RANK = int(os.environ.get("DLROVER_RANK", "0") or 0)
+
+# multi-host runtime from the agent's rendezvous env contract
+# (no-op at world 1); the mesh spans EVERY device of this world
+init_jax_distributed()
+devs = jax.devices()
+mesh = Mesh(np.array(devs), ("fsdp",))
+shard = NamedSharding(mesh, P("fsdp"))
+
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+
+def committed_step():
+    try:
+        with open(tracker) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
+
+def make_sharded(global_np):
+    # per-device placement of this process's addressable shards —
+    # works at any world size (device_put of a full host array onto
+    # a cross-process sharding would not)
+    arrs = [
+        jax.device_put(np.ascontiguousarray(global_np[index]), d)
+        for d, index in shard.addressable_devices_indices_map(
+            global_np.shape
+        ).items()
+    ]
+    return jax.make_array_from_single_device_arrays(
+        global_np.shape, shard, arrs
+    )
+
+template = make_sharded(np.zeros((DIM, 8), np.float32))
+ckpt = Checkpointer(ckpt_dir, replicated=False)
+# cross-world restores skip the shm tier (per-node, possibly
+# different steps) and RESHARD from the committed storage tier
+step0, restored = ckpt.load_checkpoint(target_state={"w": template})
+if step0 is None:
+    start_step, w = 0, template
+else:
+    start_step, w = int(step0), restored["w"]
+
+# MUST mirror scenarios.resize_reference_losses exactly: the batch is
+# derived from the step index inside the jitted program (counter-based
+# PRNG -> same bits at any world size), so the loss trajectory of any
+# incarnation matches the uninterrupted single-device control
+@jax.jit
+def step_fn(w, k):
+    x = jax.random.normal(
+        jax.random.fold_in(jax.random.PRNGKey(1000), k),
+        (8,), jnp.float32,
+    )
+    def loss_fn(w):
+        # row-sharded w: the mean over DIM is a real cross-device
+        # (and at world 2, cross-process) reduction
+        return ((w @ x - 1.0) ** 2).mean()
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - 0.1 * g, loss
+
+trainer = ElasticTrainer(global_batch_size=8, micro_batch_size=8,
+                         dp_size=1)
+trainer.global_step = start_step
+
+# dynamic data sharding rides along on the lead rank only: the
+# lockstep collective loop cannot let members consume different task
+# counts, so global rank 0 is the data feeder — exactly-once shard
+# accounting across all three world incarnations is still decided
+# from shard_ack events alone
+sc = None
+if SHARD_DATASET and RANK == 0:
+    from dlrover_tpu.agent.sharding_client import ShardingClient
+
+    sc = ShardingClient(
+        dataset_name="chaos-ds", batch_size=1, num_epochs=1,
+        dataset_size=SHARD_DATASET, shuffle=False,
+        num_minibatches_per_shard=1, storage_type="table",
+    )
+
+for k in range(start_step, TOTAL_STEPS):
+    task = None
+    if sc is not None:
+        with trainer.profile("data_wait"):
+            task = sc.fetch_task()
+    with trainer.profile("compute") as p:
+        w, loss = step_fn(w, k + 1)
+        p.block(loss)
+    trainer.report_step({"loss": float(loss)})
+    if task is not None:
+        sc.report_task_done(task.task_id)
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+    with trainer.profile("checkpoint"):
+        if DISK_EVERY and trainer.global_step % DISK_EVERY == 0:
+            ckpt.save_checkpoint(
+                trainer.global_step, {"w": w},
+                storage_type=StorageType.DISK,
+            )
+            ckpt.wait()
+            deadline = time.time() + 30
+            while (time.time() < deadline
+                   and committed_step() < trainer.global_step):
+                time.sleep(0.1)
+        else:
+            ckpt.save_checkpoint(
+                trainer.global_step, {"w": w},
+                storage_type=StorageType.MEMORY,
+            )
+
+# final durable save: every rank persists its shard; the lead rank
+# waits for the commit (needs every surviving rank's done file)
+final_sd = {"w": w}
+if RANK == 0:
+    deadline = time.time() + 60
+    while time.time() < deadline and committed_step() < TOTAL_STEPS:
+        ckpt.save_checkpoint(
+            TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+        )
+        ckpt.wait()
+        poll_end = time.time() + 10
+        while time.time() < poll_end and committed_step() < TOTAL_STEPS:
+            time.sleep(0.2)
+    assert committed_step() >= TOTAL_STEPS, (
+        "checkpoint commit did not land"
+    )
+else:
+    ckpt.save_checkpoint(
+        TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+    )
+    ckpt.wait()
+ckpt.close()
+'''
+
+
+def resize_reference_losses(total_steps: int, dim: int = 64):
+    """Uninterrupted-control loss trajectory of
+    :data:`RESIZE_TRAIN_SCRIPT`'s update rule, computed single-device
+    in-process.  ``result[k-1]`` is the loss the job must report at
+    step ``k`` regardless of world size, restarts, or resharded
+    restores — the batch derivation and update MUST stay in lockstep
+    with the script's ``step_fn``."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step_fn(w, k):
+        x = jax.random.normal(
+            jax.random.fold_in(jax.random.PRNGKey(1000), k),
+            (8,), jnp.float32,
+        )
+
+        def loss_fn(w):
+            return ((w @ x - 1.0) ** 2).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * g, loss
+
+    w = jnp.zeros((dim, 8), jnp.float32)
+    out = []
+    for k in range(1, total_steps + 1):
+        w, loss = step_fn(w, k)
+        out.append(float(loss))
+    return out
 
 
 def kill_worker_midstep(seed: int = 42) -> Scenario:
@@ -527,6 +738,63 @@ def trainer_hang_detected(seed: int = 47) -> Scenario:
     })
 
 
+def elastic_resize_churn(seed: int = 53) -> Scenario:
+    """Elastic world-resize acceptance (ISSUE 8): a NODE LOSS — one of
+    two agents dies with its whole worker tree (``kill_node``, no
+    failure report, exactly like a vanished VM) — and the job survives
+    by training SMALLER: the master's resize coordinator detects the
+    silence, decides world 2 -> 1, drains the survivor over the
+    heartbeat-action channel, and the re-formed world restores the
+    checkpoint RESHARDED (node 1's storage shards redistributed onto
+    node 0's devices).  The harness then respawns the lost agent (a
+    replacement host: fresh shm namespace, ``DLROVER_AGENT_RESPAWNED``
+    marks it so the kill rule never re-fires) and the job grows back
+    to world 2 the same way.  Wall-clock triggered (the loss IS a
+    timer event), so the timeline is bounded, not byte-stable."""
+    return Scenario.from_dict({
+        "name": "elastic-resize-churn",
+        "seed": seed,
+        "rules": [{
+            "name": "node1-loss",
+            "point": "agent.monitor",
+            "action": "kill_node",
+            "after_time": 8.0,
+            "env_equals": {
+                "DLROVER_NODE_RANK": "1",
+                "DLROVER_AGENT_RESPAWNED": "",
+            },
+        }],
+    })
+
+
+def multinode_hang_culprit(seed: int = 59) -> Scenario:
+    """Multinode hang diagnosis (ROADMAP carried-forward): freeze ONE
+    node's trainer of a two-agent job mid-step while the other keeps
+    stepping — the silence rule alone cannot convict (global progress
+    continues), so the verdict must come from the culprit-selection
+    evidence scoring over the agents' shipped flight data, and ONLY
+    node 1 may be restarted."""
+    return Scenario.from_dict({
+        "name": "multinode-hang-culprit",
+        "seed": seed,
+        "rules": [{
+            "name": "freeze-node1-midstep",
+            "point": "trainer.step",
+            "action": "stall",
+            # early: node 1's whole recovery must finish while node 0
+            # is STILL TRAINING — a peer that succeeds mid-recovery
+            # leaves the liveness set and the in-place rejoin
+            # (correctly) refuses a world with a departed member
+            "at_step": 3,
+            "max_count": 1,
+            "only_first_incarnation": True,
+            "env_equals": {"DLROVER_NODE_RANK": "1"},
+            # ended by the culprit restart's SIGTERM, never the timer
+            "args": {"seconds": 90.0},
+        }],
+    })
+
+
 def shm_corruption(seed: int = 17) -> Scenario:
     """Tear one shm snapshot right after it is written (writing=True
     republish): the persist and restore paths must refuse the torn
@@ -561,6 +829,8 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "warm_template_midspawn_kill": warm_template_midspawn_kill,
     "goodput_under_scheduled_churn": goodput_under_scheduled_churn,
     "trainer_hang_detected": trainer_hang_detected,
+    "elastic_resize_churn": elastic_resize_churn,
+    "multinode_hang_culprit": multinode_hang_culprit,
 }
 
 
@@ -625,6 +895,51 @@ RUN_OPTIONS: Dict[str, Dict] = {
     "warm-template-midspawn-kill": {"warm_restart": True},
     # run_scenario_multinode applies these to every agent process
     "multinode-rpc-partition": {"step_sleep": 0.5},
+    # elastic resize in seconds: a 2.5 s heartbeat-silence window
+    # detects the SIGKILLed node (no failure report exists), a 1 s
+    # decision grace debounces it, and sub-second master polls /
+    # monitor reports keep every control-plane reaction prompt; the
+    # loop is stretched so the kill lands mid-run and disk commits
+    # every 3 steps bound the cross-world restore's step loss
+    "elastic-resize-churn": {
+        "total_steps": 24,
+        "disk_every": 3,
+        "step_sleep": 0.3,
+        "shard_dataset": True,
+        "extra_env": {
+            "DLROVER_MONITOR_REPORT_INTERVAL": "0.5",
+            "DLROVER_HANG_DETECTION_S": "2.5",
+            "DLROVER_RESIZE_GRACE_S": "1.0",
+            "DLROVER_RESIZE_REDELIVER_S": "15.0",
+            "DLROVER_RESIZE_STOP_TIMEOUT_S": "1.5",
+            "DLROVER_SECONDS_TO_CHECK_HANG": "0.5",
+            "DLROVER_BREAKPOINT_COMMIT_TIMEOUT_S": "3",
+            # the coordinator owns BOTH resize directions: the
+            # agent-side membership fallback would race it on the
+            # grow-back and leave the decision un-journaled
+            "DLROVER_MEMBERSHIP_SELF_RESTART": "0",
+            # the world-2 mesh is 2 hosts x 2 devices; world-1 is
+            # 1 x 2 — the restore genuinely redistributes shards
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        },
+    },
+    # multinode hang: same shrunk diagnosis thresholds as the
+    # single-node scenario, but the conviction must come from the
+    # per-node evidence scoring — node 0 keeps stepping throughout,
+    # and the budget/step pacing keeps it training PAST node 1's
+    # whole recovery (a peer succeeding mid-recovery would leave the
+    # world node 1 needs to rejoin)
+    "multinode-hang-culprit": {
+        "total_steps": 16,
+        "step_sleep": 0.8,
+        "extra_env": {
+            "DLROVER_MONITOR_REPORT_INTERVAL": "0.5",
+            "DLROVER_HANG_THRESHOLD_S": "2",
+            "DLROVER_HANG_TIMEOUT": "3",
+            "DLROVER_SECONDS_TO_CHECK_HANG": "0.5",
+            "DLROVER_HANG_RESTART_GRACE_S": "20",
+        },
+    },
     # hang diagnosis in seconds instead of half an hour: fast step
     # reporting, a 2 s agent watchdog window, a 3 s master hang
     # timeout and a sub-second master poll — the 90 s stall is

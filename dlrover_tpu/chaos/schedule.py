@@ -51,6 +51,7 @@ from dlrover_tpu.common import env_utils
 KNOWN_ACTIONS = (
     "kill",          # signal own process (default SIGKILL)
     "kill_worker",   # signal a supervised worker from ctx["procs"]
+    "kill_node",     # kill worker tree then self (node-loss parity)
     "drop",          # raise ConnectionError (RPC drop / partition)
     "delay",         # sleep args["seconds"] then continue (RPC delay)
     "io_error",      # raise OSError (storage fault)

@@ -130,9 +130,17 @@ class CheckpointEngine:
         self._shm_lock = SharedLock(
             f"{LOCK_PREFIX}_{self._local_rank}", create=False
         )
+        # the LOCAL lead process drives its node's saver: each agent
+        # hosts one saver and persists its node's shards, so every
+        # node's local rank 0 must enqueue SAVE events.  (Gating on
+        # GLOBAL rank 0 — the old condition — meant a multi-NODE
+        # GSPMD job never persisted rank>0 shards: node 1's saver got
+        # no events, and the world-2 commit waited forever for a done
+        # file nobody would write.  Found by the elastic-resize chaos
+        # run.)
         self._event_queue = (
             SharedQueue(EVENT_QUEUE, create=False)
-            if self._rank == 0 else None
+            if self._local_rank == 0 else None
         )
         self._storage = get_checkpoint_storage(path=checkpoint_dir)
         self._notified_agent = False
@@ -559,6 +567,23 @@ class CheckpointEngine:
             config, flat, metas = self._shm_handler.load_flat(
                 detach=False, stats=stats
             )
+            if config is not None and int(
+                getattr(config, "world_size", 0) or 0
+            ) != self._world_size:
+                # elastic world-resize: an shm snapshot from a
+                # DIFFERENT world size is per-node state — each
+                # survivor's segment may hold a different step, so
+                # assembling from them would desync the re-formed
+                # world.  Cross-world restores use the globally
+                # COMMITTED storage tier; that is where the N-hosts ->
+                # M-hosts shard redistribution happens.
+                logger.warning(
+                    "shm snapshot is from world size %s but this "
+                    "world is %s; skipping the shm tier (cross-world "
+                    "restores reshard from committed storage)",
+                    config.world_size, self._world_size,
+                )
+                config, flat = None, {}
             if config is not None and flat:
                 state = self._assemble_to_target(
                     target_state, flat, metas, stats

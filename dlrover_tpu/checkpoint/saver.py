@@ -49,6 +49,11 @@ _COMMITTED_STEP = _REG.gauge(
     "dlrover_checkpoint_committed_step",
     "Latest step whose tracker file was committed",
 )
+_PREFETCH_SECONDS = _REG.histogram(
+    "dlrover_shm_prefetch_seconds",
+    "Agent-side page-in of the shm snapshot overlapping the "
+    "replacement trainer's import (restore prefetch hint)",
+)
 
 FACTORY_QUEUE = "ckpt_factory"
 EVENT_QUEUE = "ckpt_event_queue"
@@ -186,7 +191,73 @@ class AsyncCheckpointSaver:
         step = min(steps)
         if step > saver._last_persisted_step:
             logger.info("breakpoint-saving shm checkpoint step %s", step)
-            saver.save_step_checkpoint(step)
+            # bounded commit wait: a breakpoint save runs INSIDE the
+            # agent's restart path, and in a multi-node world the
+            # commit needs every node's shard — a world that just
+            # SHRANK can never produce them.  The local shard upload
+            # is the durable part; an uncommitted step dir is
+            # harmless (restores read the tracker), so the commit
+            # poll must not stall a resize for SAVE_TIMEOUT.
+            try:
+                commit_timeout = float(os.environ.get(
+                    "DLROVER_BREAKPOINT_COMMIT_TIMEOUT_S", "20"
+                ))
+            except ValueError:
+                commit_timeout = 20.0
+            saver.save_step_checkpoint(
+                step, commit_timeout=commit_timeout
+            )
+
+    @classmethod
+    def prefetch_shm_snapshots(cls, restart_count: int = 0) -> int:
+        """Restore prefetch hint (ROADMAP 3b): touch every page of
+        each shm snapshot so the segment is resident BEFORE the
+        replacement trainer attaches it.  Called by the agent on a
+        daemon thread right after it stops the dead workers — the
+        page-ins overlap the new trainer's interpreter + jax import
+        (seconds), which previously hid nothing: the trainer paid the
+        fault-bound term itself inside the restore's assemble stage.
+        Read-only strided touches: data is discarded, only the page
+        mappings persist.  Returns bytes touched."""
+        saver = cls._instance
+        if saver is None:
+            return 0
+        import numpy as _np
+
+        t0 = time.time()
+        touched = 0
+        segments = 0
+        for handler in saver._shm_handlers:
+            try:
+                meta = handler.metadata()
+                if not meta:
+                    continue
+                total = meta["scalar_offset"] + meta["scalar_nbytes"]
+                shm = handler._attach(min_size=total)
+                if shm is None:
+                    continue
+                _np.frombuffer(
+                    shm.buf, dtype=_np.uint8, count=total
+                )[::4096].sum()
+                touched += total
+                segments += 1
+            except Exception:  # noqa: BLE001 - best-effort warmup
+                logger.exception("shm prefetch failed for a shard")
+        seconds = time.time() - t0
+        if segments:
+            _PREFETCH_SECONDS.observe(seconds)
+            emit_event(
+                "shm_prefetch",
+                bytes=touched,
+                seconds=round(seconds, 4),
+                segments=segments,
+                restart_count=restart_count,
+            )
+            logger.info(
+                "prefetched %d shm snapshot segment(s), %.1f MB in "
+                "%.3fs", segments, touched / 2**20, seconds,
+            )
+        return touched
 
     @classmethod
     def register_signal_handler(cls):
@@ -241,7 +312,9 @@ class AsyncCheckpointSaver:
 
     # -- persist -----------------------------------------------------------
 
-    def save_step_checkpoint(self, step: int):
+    def save_step_checkpoint(
+        self, step: int, commit_timeout: Optional[float] = None,
+    ):
         """Persist every local shard of ``step`` then commit
         (reference: save_step_checkpoint, ckpt_saver.py:795)."""
         start = time.time()
@@ -278,7 +351,13 @@ class AsyncCheckpointSaver:
             )
             return
         if self.config.node_rank == 0:
-            self.commit_checkpoint(step, step_dir)
+            self.commit_checkpoint(
+                step, step_dir,
+                timeout=(
+                    commit_timeout if commit_timeout is not None
+                    else CheckpointConstant.SAVE_TIMEOUT
+                ),
+            )
         self._last_persisted_step = step
         elapsed = time.time() - start
         _PERSIST_SECONDS.observe(elapsed)
@@ -384,7 +463,14 @@ class AsyncCheckpointSaver:
         ckpt_saver.py:860)."""
         deadline = time.time() + timeout
         expected = self.config.global_shard_num
+        done: List[str] = []
         while time.time() < deadline:
+            # re-read each iteration: an elastic resize ships a new
+            # SaverConfig through the FACTORY thread (which replaces
+            # self.config live), so a poll waiting for a world that
+            # no longer exists picks up the shrunken shard count and
+            # unwedges — whichever thread it runs on
+            expected = self.config.global_shard_num
             try:
                 done = [
                     f for f in self.storage.listdir(step_dir)

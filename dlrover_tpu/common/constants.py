@@ -93,9 +93,14 @@ class ErrorMonitorConstants:
 
 class MasterAction:
     """Actions the master piggybacks on a heartbeat ack for the agent
-    to execute (the diagnosis chain's culprit-only relaunch path)."""
+    to execute (the diagnosis chain's culprit-only relaunch path and
+    the elastic world-resize drain)."""
 
     RESTART_WORKERS = "restart_workers"
+    # elastic world-resize: stop the local workers and re-join the
+    # rendezvous so the job reconverges at the master's new target
+    # world size (a planned drain, not a failure — no restart budget)
+    RESIZE = "resize"
 
 
 class CheckpointConstant:

@@ -18,8 +18,13 @@ class Context(Singleton):
         self.rdzv_default_timeout = 600
         self.seconds_to_wait_pending_pod = 900
         # heartbeat: node considered dead after this silence window
-        # (reference: dist_job_manager.py:355 300s window)
-        self.hang_detection_seconds = 300
+        # (reference: dist_job_manager.py:355 300s window).  Env-
+        # overridable: the elastic-resize chaos scenario shrinks it so
+        # a SIGKILLed node (no failure report possible) is detected in
+        # seconds and the resize decision path can play out tier-1
+        self.hang_detection_seconds = _env_float(
+            "DLROVER_HANG_DETECTION_S", 300
+        )
         # master main-loop hang checks (env-overridable: the chaos
         # hang scenario shrinks both so a tier-1 run diagnoses a
         # frozen trainer in seconds, not half an hour)

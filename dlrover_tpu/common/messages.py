@@ -307,6 +307,17 @@ class ScaleRequest(Message):
 
 
 @dataclass
+class ResizeRequest(Message):
+    """Operator-requested world resize: ask the master's resize
+    coordinator to reconverge the job at ``target`` nodes (the manual
+    flavour of the alive-count-driven decision; reference: ScalePlan
+    CRD written by an operator)."""
+
+    target: int = 0
+    reason: str = "operator"
+
+
+@dataclass
 class JobExitRequest(Message):
     reason: str = ""
 

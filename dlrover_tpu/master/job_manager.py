@@ -141,6 +141,34 @@ class JobManager:
         self._fire(NodeEvent(event_type, node))
         return True
 
+    def handle_node_rejoin(self, node_id: int, node_type: str = ""):
+        """A node the master wrote off (heartbeat silence, reported
+        death) joined the rendezvous again: a replacement agent under
+        the same identity.  Transition it back to RUNNING so liveness,
+        rendezvous membership and speed accounting re-admit it —
+        elastic grow-back rides this.  A journaled terminal decision
+        stands: a declined node does not resurrect by rejoining."""
+        node = self.get_node(node_id)
+        if node is None:
+            return False
+        if node_id in self._terminal_decisions:
+            logger.info(
+                "node %s rejoined but its terminal decision %r "
+                "stands; not re-admitting", node_id,
+                self._terminal_decisions[node_id],
+            )
+            return False
+        if node.status not in (NodeStatus.FAILED, NodeStatus.DELETED):
+            return False
+        logger.warning(
+            "node %s rejoined after %s; re-admitting as RUNNING",
+            node_id, node.status,
+        )
+        node.heartbeat_time = time.time()
+        return self.update_node_status(
+            node_id, node_type or node.type, NodeStatus.RUNNING,
+        )
+
     def handle_preemption_notice(self, node_id: int, node_type: str):
         """ADVANCE preemption notice: the node is still alive and
         stepping, so it must NOT transition to an end state here (the
@@ -199,9 +227,12 @@ class JobManager:
 
     def _monitor_heartbeats(self):
         """Dead-node events after a silence window (reference
-        ``_monitor_node_heart_beat:355``, 300 s)."""
+        ``_monitor_node_heart_beat:355``, 300 s).  The poll cadence
+        tracks the window: a seconds-scale window (elastic-resize
+        chaos runs) must not sit behind a fixed 15 s poll."""
         window = Context.instance().hang_detection_seconds
-        while not self._stop.wait(15.0):
+        poll = max(0.5, min(15.0, window / 3.0))
+        while not self._stop.wait(poll):
             now = time.time()
             for node in self.all_nodes().values():
                 if (

@@ -19,6 +19,17 @@ def parse_args(argv=None):
     parser = argparse.ArgumentParser(description="dlrover_tpu job master")
     parser.add_argument("--port", type=int, default=DefaultPorts.MASTER)
     parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument(
+        "--min_nodes", type=int, default=0,
+        help="elastic floor: the job keeps training as long as this "
+        "many nodes survive (0 = node_num, i.e. fixed world; also "
+        "via DLROVER_MIN_NODES).  min_nodes < node_num arms the "
+        "resize coordinator",
+    )
+    parser.add_argument(
+        "--node_unit", type=int, default=1,
+        help="world size changes in multiples of this many nodes",
+    )
     parser.add_argument("--job_name", type=str, default="local-job")
     parser.add_argument(
         "--platform",
@@ -64,6 +75,8 @@ def create_master(args) -> JobMaster:
             port=args.port, node_num=args.node_num,
             job_name=args.job_name,
             journal_dir=args.journal_dir or None,
+            min_node_num=args.min_nodes or None,
+            node_unit=args.node_unit,
         )
     from dlrover_tpu.master.auto_scaler import AllreduceAutoScaler
     from dlrover_tpu.master.node_manager import DistributedJobManager

@@ -67,9 +67,24 @@ class JobMaster:
         coordinator_port: int = 0,
         job_manager: Optional[JobManager] = None,
         journal_dir: Optional[str] = None,
+        min_node_num: Optional[int] = None,
+        node_unit: int = 1,
     ):
         self.job_name = job_name
         self.node_num = node_num
+        # elastic floor: min_node_num < node_num arms the resize
+        # coordinator — the job survives capacity loss by training
+        # smaller instead of waiting for a replacement (env
+        # DLROVER_MIN_NODES when not passed)
+        if min_node_num is None:
+            try:
+                min_node_num = int(
+                    os.getenv("DLROVER_MIN_NODES", "") or node_num
+                )
+            except ValueError:
+                min_node_num = node_num
+        self.min_node_num = max(1, min(min_node_num, node_num))
+        self.node_unit = max(1, node_unit)
         # a fresh id per master PROCESS: agents compare it across
         # session resyncs to detect that a recovery happened
         self.incarnation = uuid.uuid4().hex[:12]
@@ -108,7 +123,8 @@ class JobMaster:
         coordinator_port = coordinator_port or find_free_port()
         for mngr in self.rdzv_managers.values():
             mngr.update_rdzv_params(
-                min_nodes=node_num, max_nodes=node_num, node_unit=1
+                min_nodes=self.min_node_num, max_nodes=node_num,
+                node_unit=self.node_unit,
             )
             mngr.set_coordinator_port(coordinator_port)
         # node-event callbacks (reference: event_callback.py objects)
@@ -132,6 +148,22 @@ class JobMaster:
             kv_store=self.kv_store,
             speed_monitor=self.speed_monitor,
         )
+        # elastic world-resize: decides a new target from alive-node
+        # counts / operator requests and drains survivors over the
+        # heartbeat-action channel (journal attached below so a crash
+        # mid-resize replays the decision)
+        from dlrover_tpu.master.auto_scaler import ResizeCoordinator
+
+        self.resize_coordinator = ResizeCoordinator(
+            self.elastic_rdzv,
+            self.job_manager,
+            self.speed_monitor,
+            self.servicer,
+            min_nodes=self.min_node_num,
+            max_nodes=node_num,
+            node_unit=self.node_unit,
+        )
+        self.servicer.resize_coordinator = self.resize_coordinator
         # -- crash recovery: state journal + replay --------------------
         self.journal: Optional[StateJournal] = None
         jdir = journal_dir or os.getenv(JOURNAL_DIR_ENV, "")
@@ -163,6 +195,7 @@ class JobMaster:
             self.task_manager.journal = self.journal
             self.job_manager.journal = self.journal
             self.servicer.journal = self.journal
+            self.resize_coordinator.journal = self.journal
             for mngr in self.rdzv_managers.values():
                 mngr.on_round_complete = self._journal_rdzv_round
             # check RESULTS are journaled too, not just membership —
@@ -256,6 +289,9 @@ class JobMaster:
             mngr.update_rdzv_params(
                 min_nodes=min_nodes, max_nodes=max_nodes, node_unit=node_unit
             )
+        self.resize_coordinator.min_nodes = max(1, min_nodes)
+        self.resize_coordinator.max_nodes = max(min_nodes, max_nodes)
+        self.resize_coordinator.node_unit = max(1, node_unit)
 
     def prepare(self):
         self.task_manager.start()
@@ -323,6 +359,13 @@ class JobMaster:
                     self.slo_checker.check()
                 except Exception:  # noqa: BLE001 - policing must
                     logger.exception("SLO check failed")  # not kill
+                # elastic world-resize: capacity changes (node loss,
+                # rejoin, operator request) converge the job to a new
+                # world size instead of stalling it on the old one
+                try:
+                    self.resize_coordinator.poll()
+                except Exception:  # noqa: BLE001 - a resize bug must
+                    logger.exception("resize poll failed")  # not kill
                 # inference-chain diagnosis over the agents' reported
                 # evidence (stacks, hang flight data, per-node step
                 # times, step-phase breakdowns) — the hang verdict
@@ -357,8 +400,26 @@ class JobMaster:
                         "straggler rule)", verdict.reason,
                     )
                 if self.task_manager.finished():
-                    logger.info("all dataset tasks completed")
-                    break
+                    # workers still RUNNING are finishing their final
+                    # saves / exit handshakes: exiting the control
+                    # plane now strands them on a dead master (their
+                    # RPCs park for a respawn that never comes) — so
+                    # the dataset's completion only ends the job once
+                    # no worker is left running
+                    from dlrover_tpu.common.constants import (
+                        NodeStatus as _NS,
+                        NodeType as _NT,
+                    )
+
+                    running = [
+                        n for n in
+                        self.job_manager.all_nodes().values()
+                        if n.type == _NT.WORKER
+                        and n.status == _NS.RUNNING
+                    ]
+                    if not running:
+                        logger.info("all dataset tasks completed")
+                        break
         finally:
             self.stop()
             emit_event(

@@ -152,16 +152,56 @@ class RendezvousManager:
         ):
             _JOIN_TOTAL.inc(rdzv=self._name)
             with self._lock:
-                self._waiting_nodes[node_rank] = NodeMeta(
+                meta = NodeMeta(
                     node_id=node_id,
                     node_rank=node_rank,
                     local_world_size=local_world_size,
                     node_ip=node_ip,
                 )
+                if self._is_inplace_rejoin(node_rank, node_id):
+                    # culprit-only restart (hang diagnosis) coming
+                    # back to its OWN slot of a world that is
+                    # otherwise unchanged: hand the current round
+                    # back instead of opening a new one — the
+                    # healthy members never re-join, so a fresh
+                    # round could never complete, and even showing
+                    # this node as "waiting" would trip the peers'
+                    # membership-change polls into restarting
+                    # (surfaced by the multinode hang chaos run)
+                    self._rdzv_nodes[node_rank] = meta
+                    self._alive_nodes.add(node_id)
+                    logger.info(
+                        "%s: node %s re-joined round %d in place",
+                        self._name, node_rank, self._rdzv_round,
+                    )
+                    return self._rdzv_round
+                self._waiting_nodes[node_rank] = meta
                 self._alive_nodes.add(node_id)
                 if not self._start_waiting_time:
                     self._start_waiting_time = time.time()
                 return self._rdzv_round
+
+    def _is_inplace_rejoin(self, node_rank: int, node_id: int) -> bool:
+        """Caller holds the lock.  True when ``node_rank`` already
+        belongs to the current multi-node round under the same
+        node_id, every member of that round is still alive (no
+        capacity change pending — a dead member means the world MUST
+        shrink through a new round), and nothing but current members
+        sits in the waiting pool (a newcomer means the world is
+        re-forming anyway).  Single-node rounds keep the old
+        round-per-restart behaviour: there is no peer to disturb and
+        the reconvergence trail stays observable."""
+        members = self._rdzv_nodes
+        if len(members) <= 1 or node_rank not in members:
+            return False
+        if members[node_rank].node_id != node_id:
+            return False  # a REPLACEMENT host re-forms the world
+        if any(
+            m.node_id not in self._alive_nodes
+            for m in members.values()
+        ):
+            return False
+        return all(r in members for r in self._waiting_nodes)
 
     def _check_rdzv_completed(self) -> bool:
         """Caller holds the lock.  Mirrors reference
@@ -173,8 +213,16 @@ class RendezvousManager:
         alive = max(len(self._alive_nodes), 1)
         complete = False
         if waiting >= min(alive, p.max_nodes) and waiting >= p.min_nodes:
-            complete = True
-        elif (
+            # elastic jobs (min < max): the FIRST round must not
+            # complete below max_nodes just because the slower agents
+            # have not joined/heartbeated yet — joiner order would
+            # decide the initial world.  Below-capacity initial worlds
+            # form through the timeout branch; once a round exists,
+            # capacity-loss reconvergence stays instant.
+            complete = not (
+                self._rdzv_round == 0 and waiting < p.max_nodes
+            )
+        if not complete and (
             waiting >= p.min_nodes
             and self._start_waiting_time
             and time.time() - self._start_waiting_time > p.waiting_timeout
@@ -283,6 +331,29 @@ class RendezvousManager:
         with self._lock:
             return len(self._waiting_nodes)
 
+    # -- resize coordinator view -------------------------------------------
+
+    def latest_world_size(self) -> int:
+        """Nodes in the latest COMPLETED round (0 before the first)."""
+        with self._lock:
+            return len(self._rdzv_nodes)
+
+    def latest_node_ids(self) -> List[int]:
+        """node_ids of the latest completed round's participants."""
+        with self._lock:
+            return [m.node_id for m in self._rdzv_nodes.values()]
+
+    def alive_node_ids(self) -> List[int]:
+        """Current liveness view (joined or heartbeat-confirmed nodes
+        minus the ones the failure/heartbeat paths removed) — the
+        resize coordinator's measure of available capacity."""
+        with self._lock:
+            return sorted(self._alive_nodes)
+
+    def waiting_node_ids(self) -> List[int]:
+        with self._lock:
+            return [m.node_id for m in self._waiting_nodes.values()]
+
     def _world(self) -> Dict[int, int]:
         """Iteration ORDER of the returned dict is the global rank
         order (preserved through pickle); the topology sorter places
@@ -349,6 +420,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         # already arrived, so fault confirmation ("abnormal in two
         # consecutive rounds") survives the restart
         self.on_status_report = None
+
+    def _is_inplace_rejoin(self, node_rank: int, node_id: int) -> bool:
+        """Never: every check ROUND is a fresh join of all members by
+        design (round 0 neighbour pairs, round 1 re-paired by elapsed
+        time) — resolving a join in place would stop the second round
+        from ever forming."""
+        return False
 
     def _group_nodes(self, ranks: List[int]) -> List[List[int]]:
         """Round 0: neighbour pairs; round >0: sorted by previous

@@ -29,6 +29,7 @@ def capture_snapshot(master) -> Dict[str, Any]:
         "task_manager": master.task_manager.snapshot_state(),
         "job_manager": master.job_manager.snapshot_state(),
         "kv": master.kv_store.dump(),
+        "resize": master.resize_coordinator.journal_state(),
     }
 
 
@@ -61,6 +62,11 @@ def restore_master(master, replayed: JournalReplay) -> Dict[str, int]:
                 # from the snapshot, not just membership
                 if hasattr(mngr, "restore_check_state"):
                     mngr.restore_check_state(state)
+        # AFTER the rdzv rounds: pending-ness of a replayed resize is
+        # judged against the restored round/world
+        master.resize_coordinator.restore_state(
+            snap.get("resize") or {}
+        )
     applied = 0
     for _seq, kind, data in replayed.entries:
         try:
@@ -77,6 +83,11 @@ def restore_master(master, replayed: JournalReplay) -> Dict[str, int]:
                         data.get("round", 0),
                         data.get("participants") or {},
                     )
+                applied += 1
+                continue
+            if master.resize_coordinator.apply_journal_entry(
+                kind, data
+            ):
                 applied += 1
                 continue
             if kind == "netcheck_status":
@@ -108,6 +119,7 @@ def restore_master(master, replayed: JournalReplay) -> Dict[str, int]:
             logger.exception(
                 "journal replay failed for %r record", kind
             )
+    master.resize_coordinator.reconcile_after_replay()
     requeued = master.task_manager.requeue_unacked()
     if requeued:
         logger.info(

@@ -69,6 +69,9 @@ class MasterServicer(RequestHandler):
         # ack (diagnosis chain's culprit-only relaunch); one pending
         # action per node, latest wins
         self._node_actions: Dict[int, str] = {}
+        # elastic world-resize: set by JobMaster; operator
+        # ResizeRequest messages land here
+        self.resize_coordinator = None
 
     def request_node_action(self, node_id: int, action: str):
         """Queue ``action`` for delivery on node ``node_id``'s next
@@ -108,7 +111,25 @@ class MasterServicer(RequestHandler):
                 message.local_world_size,
                 message.node_ip,
             )
+            # a join from a node the master wrote off (heartbeat
+            # silence, reported death) is a REJOIN: a replacement
+            # agent came back under the same identity and must flow
+            # back into the liveness/speed accounting — elastic
+            # grow-back depends on it
+            self._job_manager.handle_node_rejoin(
+                message.node_id, node_type
+            )
             self._job_manager.collect_heartbeat(message.node_id)
+            if (message.rdzv_name or RendezvousName.ELASTIC_TRAINING
+                    ) == RendezvousName.ELASTIC_TRAINING:
+                # this node's previous trainer incarnation is
+                # definitively gone (its agent is re-forming the
+                # world): any dataset lease it still holds would
+                # otherwise sit in `doing` until the 30-min timeout
+                # and wedge the epoch's tail — re-queue it now
+                self._task_manager.recycle_worker_tasks(
+                    message.node_id
+                )
             return msg.JoinRendezvousResponse(round=round_)
 
         if isinstance(message, msg.CommWorldRequest):
@@ -343,6 +364,17 @@ class MasterServicer(RequestHandler):
         if isinstance(message, msg.ReadyToExitRequest):
             self._job_manager.update_node_status(
                 message.node_id, "worker", "succeeded"
+            )
+            return True
+
+        if isinstance(message, msg.ResizeRequest):
+            if self.resize_coordinator is None:
+                logger.warning(
+                    "resize request ignored: no coordinator wired"
+                )
+                return False
+            self.resize_coordinator.request(
+                message.target, message.reason or "operator"
             )
             return True
 

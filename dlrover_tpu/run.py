@@ -94,26 +94,32 @@ def _launch_local_master(
     port: int = 0,
     journal_dir: str = "",
     restart_count: int = 0,
+    min_nodes: int = 0,
+    node_unit: int = 1,
 ) -> Tuple[subprocess.Popen, str]:
     """Spawn ``python -m dlrover_tpu.master.main`` for single-node /
     test jobs (reference: _launch_dlrover_local_master,
     elastic_run.py:237).  ``journal_dir`` arms crash recovery: a
     respawned master pointed at the same directory replays the state
     journal; ``restart_count`` tells the new incarnation (and its
-    chaos rules) that it IS a respawn."""
+    chaos rules) that it IS a respawn.  ``min_nodes < max_nodes``
+    (from ``--nnodes MIN:MAX``) arms the master's elastic resize
+    coordinator."""
     port = port or find_free_port()
     env = dict(os.environ)
     if journal_dir:
         env[JOURNAL_DIR_ENV] = journal_dir
     env[NodeEnv.RESTART_COUNT] = str(restart_count)
-    proc = subprocess.Popen(  # noqa: S603
-        [
-            sys.executable, "-m", "dlrover_tpu.master.main",
-            "--port", str(port),
-            "--node_num", str(max_nodes),
-        ],
-        env=env,
-    )
+    argv = [
+        sys.executable, "-m", "dlrover_tpu.master.main",
+        "--port", str(port),
+        "--node_num", str(max_nodes),
+    ]
+    if min_nodes:
+        argv += ["--min_nodes", str(min_nodes)]
+    if node_unit > 1:
+        argv += ["--node_unit", str(node_unit)]
+    proc = subprocess.Popen(argv, env=env)  # noqa: S603
     addr = f"127.0.0.1:{port}"
     deadline = time.time() + 30
     while time.time() < deadline:
@@ -135,11 +141,14 @@ class _MasterSupervisor:
     replay every time must eventually fail the job)."""
 
     def __init__(self, proc: subprocess.Popen, addr: str,
-                 max_nodes: int, journal_dir: str):
+                 max_nodes: int, journal_dir: str,
+                 min_nodes: int = 0, node_unit: int = 1):
         self.proc = proc
         self.addr = addr
         self._port = int(addr.rsplit(":", 1)[1])
         self._max_nodes = max_nodes
+        self._min_nodes = min_nodes
+        self._node_unit = node_unit
         self._journal_dir = journal_dir
         self._max_restarts = int(
             os.environ.get(MASTER_MAX_RESTARTS_ENV, "3") or 3
@@ -186,6 +195,8 @@ class _MasterSupervisor:
                     port=self._port,
                     journal_dir=self._journal_dir,
                     restart_count=self.restarts,
+                    min_nodes=self._min_nodes,
+                    node_unit=self._node_unit,
                 )
             except RuntimeError as e:
                 logger.error("master respawn failed: %s", e)
@@ -248,11 +259,14 @@ def run(args) -> int:
         if not journal_dir:
             journal_dir = tempfile.mkdtemp(prefix="dlrover_mjournal_")
             journal_dir_created = journal_dir
+        elastic_min = min_nodes if min_nodes < max_nodes else 0
         master_proc, master_addr = _launch_local_master(
-            max_nodes, journal_dir=journal_dir
+            max_nodes, journal_dir=journal_dir,
+            min_nodes=elastic_min, node_unit=args.node_unit,
         )
         supervisor = _MasterSupervisor(
-            master_proc, master_addr, max_nodes, journal_dir
+            master_proc, master_addr, max_nodes, journal_dir,
+            min_nodes=elastic_min, node_unit=args.node_unit,
         )
         logger.info(
             "launched local master at %s (journal %s)",

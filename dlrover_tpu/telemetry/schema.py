@@ -87,7 +87,11 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
             "master_changed", "last_step",
         ]),
         # -- trainer -------------------------------------------------
-        _s("train_step", ["step", "restart_count", "node_rank"]),
+        # loss rides along when the step loop reported it: the
+        # elastic-resize loss-trajectory invariant compares same-step
+        # losses across incarnations/world sizes from the log alone
+        _s("train_step", ["step", "restart_count", "node_rank"],
+           ["loss"]),
         _s("loss_spike", ["step", "loss", "ema", "factor"]),
         # per-step phase breakdown from the always-on profiler
         # (open dict: data_wait / h2d / compute / checkpoint /
@@ -101,7 +105,14 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         _s("checkpoint_persist", ["step", "ok", "seconds"]),
         _s("checkpoint_commit", ["step"]),
         # -- agent ---------------------------------------------------
-        _s("worker_restart", ["node_rank", "restart_count"]),
+        # reason: failure / membership / hang / resize — what drove
+        # this restart (resize restarts are planned drains)
+        _s("worker_restart", ["node_rank", "restart_count"],
+           ["reason"]),
+        # restore prefetch hint: agent paged the shm snapshot in
+        # while the replacement trainer was importing
+        _s("shm_prefetch", ["bytes", "seconds"],
+           ["segments", "restart_count"]),
         _s("warm_fork_fallback", [
             "node_rank", "local_rank", "restart_count", "reason",
         ]),
@@ -127,6 +138,18 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
             "scenario", "seed", "seq", "point", "rule", "action",
             "step", "node_rank",
         ]),
+        # -- elastic world-resize ------------------------------------
+        # the coordinator's journaled decision (target world size,
+        # why, what it decided from); detected_ts = the lost node's
+        # last sign of life, so the timeline's decide phase covers
+        # the real outage
+        _s("resize_decision",
+           ["target", "from_world", "reason", "round"],
+           ["detected_ts"]),
+        # master-observed resize phase completions (rendezvous /
+        # first_step); drain and reshard-restore are derived on the
+        # assembled timeline from worker_restart/checkpoint_restore
+        _s("resize_phase", ["phase", "seconds", "target"]),
         # -- flight recorder (this PR) -------------------------------
         _s("goodput_attribution", [
             "window_start", "window_end", "window_s", "training_s",

@@ -53,7 +53,12 @@ from dlrover_tpu.telemetry.events import (
 from dlrover_tpu.telemetry.metrics import get_registry
 
 # cause buckets, in attribution priority order: when slices overlap a
-# lost interval, the more specific cause wins the overlap
+# lost interval, the more specific cause wins the overlap.  A resize
+# window (decision -> first step of the re-formed world) claims FIRST:
+# the restores/rendezvous/restarts inside it happened BECAUSE of the
+# resize, and booking them separately would hide what capacity changes
+# actually cost.
+CAUSE_RESIZE = "resize"
 CAUSE_RESTORE = "restore"
 CAUSE_MASTER_RECOVERY = "master_recovery"
 CAUSE_HANG = "hang"
@@ -61,8 +66,14 @@ CAUSE_RENDEZVOUS = "rendezvous"
 CAUSE_STRAGGLER = "straggler"
 CAUSE_UNATTRIBUTED = "unattributed"
 CAUSE_PRIORITY = (
-    CAUSE_RESTORE, CAUSE_MASTER_RECOVERY, CAUSE_HANG,
+    CAUSE_RESIZE, CAUSE_RESTORE, CAUSE_MASTER_RECOVERY, CAUSE_HANG,
     CAUSE_RENDEZVOUS, CAUSE_STRAGGLER,
+)
+# resize phases as they appear on the assembled timeline (the
+# dlrover_resize_seconds breakdown): derived per resize_decision from
+# the raw event trail
+RESIZE_PHASES = (
+    "decide", "drain", "rendezvous", "reshard_restore", "first_step",
 )
 
 # span name -> cause category for span-derived slices
@@ -216,6 +227,7 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
     _assemble_restarts(ev, tl)
     _assemble_master_recoveries(ev, tl)
     _assemble_shard_leases(ev, tl)
+    _assemble_resizes(ev, tl)
 
     tl.steps_by_track = {k: sorted(v) for k, v in steps.items()}
     all_steps = sorted(
@@ -305,6 +317,95 @@ def _assemble_master_recoveries(ev: List[Dict], tl: JobTimeline):
                 "incarnation": e.get("incarnation"),
             },
         ))
+
+
+def _assemble_resizes(ev: List[Dict], tl: JobTimeline):
+    """Per ``resize_decision``: the five-phase breakdown of one
+    elastic world-resize, derived from the raw event trail —
+
+    - **decide**: lost node's last sign of life (``detected_ts``) →
+      the decision event;
+    - **drain**: decision → the last ``worker_restart`` before the
+      round completes (survivors stopping their old-world workers);
+    - **rendezvous**: drain end → the first elastic-training
+      ``rendezvous_complete`` whose world has exactly ``target``
+      nodes;
+    - **reshard_restore**: round completion → the last
+      ``checkpoint_restore`` of the re-formed world (the shards being
+      re-distributed onto the new mesh);
+    - **first_step**: restore end → the first ``train_step`` after it.
+
+    This is the timeline face of ``dlrover_resize_seconds``; the
+    master's coordinator observes decide/rendezvous/first_step live,
+    the agent/trainer-side phases only exist here."""
+    for i, e in enumerate(ev):
+        if e.get("type") != "resize_decision":
+            continue
+        target = e.get("target")
+        decided = _num(e.get("ts"))
+        detected = _num(e.get("detected_ts"), decided) or decided
+        # the resize ends at the round that reconverged at target
+        round_ts = None
+        for later in ev[i + 1:]:
+            if later.get("type") == "resize_decision":
+                break  # superseded before completing
+            if (
+                later.get("type") == "rendezvous_complete"
+                and later.get("rdzv") == "elastic-training"
+                and len(later.get("nodes") or []) == target
+            ):
+                round_ts = _num(later.get("ts"))
+                break
+        end_of = {"decide": decided}
+        bound = round_ts if round_ts is not None else float("inf")
+        drain_end = decided
+        for later in ev[i + 1:]:
+            ts = _num(later.get("ts"))
+            if ts > bound:
+                break
+            if later.get("type") == "resize_decision":
+                break  # superseded: later restarts belong to it
+            if later.get("type") == "worker_restart":
+                drain_end = max(drain_end, ts)
+        if drain_end > decided:
+            end_of["drain"] = drain_end
+        if round_ts is not None:
+            end_of["rendezvous"] = round_ts
+            restore_end = round_ts
+            step_ts = None
+            for later in ev[i + 1:]:
+                ts = _num(later.get("ts"))
+                if ts <= round_ts:
+                    continue
+                etype = later.get("type")
+                if etype == "resize_decision":
+                    break
+                if etype == "checkpoint_restore" and step_ts is None:
+                    restore_end = max(restore_end, ts)
+                elif etype == "train_step" and ts >= restore_end:
+                    step_ts = ts
+                    break
+            if restore_end > round_ts:
+                end_of["reshard_restore"] = restore_end
+            if step_ts is not None:
+                end_of["first_step"] = step_ts
+        start = detected
+        for phase in RESIZE_PHASES:
+            end = end_of.get(phase)
+            if end is None:
+                continue
+            tl.slices.append(Slice(
+                name=f"resize[{phase}] →{target}",
+                cat=CAUSE_RESIZE,
+                start=min(start, end), end=end, track="master",
+                meta={
+                    "phase": phase,
+                    "target": target,
+                    "from_world": e.get("from_world"),
+                    "reason": e.get("reason"),
+                },
+            ))
+            start = end
 
 
 def _assemble_shard_leases(ev: List[Dict], tl: JobTimeline):
@@ -496,6 +597,9 @@ def attribute_goodput_loss(tl: JobTimeline) -> Dict:
         ):
             straggler_iv.append((ts - 1.0, ts))
     cause_iv = {
+        CAUSE_RESIZE: [
+            (s.start, s.end) for s in tl.slices_by_cat(CAUSE_RESIZE)
+        ],
         CAUSE_RESTORE: [
             (s.start, s.end) for s in tl.slices_by_cat(CAUSE_RESTORE)
         ],

@@ -291,14 +291,23 @@ class ElasticTrainer:
         # per-step training event: this is what lets the chaos
         # invariant checkers compute "steps lost across a fault" from
         # the event log alone (no-op unless an event log is configured)
-        emit_event(
-            "train_step",
-            step=self.global_step,
-            restart_count=self._restart_count,
+        step_event = {
+            "step": self.global_step,
+            "restart_count": self._restart_count,
             # which node stepped: multi-agent chaos invariants decide
             # per-node progress from the event log alone
-            node_rank=env_utils.get_node_rank(),
-        )
+            "node_rank": env_utils.get_node_rank(),
+        }
+        if metrics and "loss" in metrics:
+            # the elastic-resize loss-trajectory invariant compares
+            # same-step losses across incarnations and world sizes —
+            # a resharded restore that mangled the params shows up
+            # as a divergence here, decided from the log alone
+            try:
+                step_event["loss"] = float(metrics["loss"])
+            except (TypeError, ValueError):
+                pass
+        emit_event("train_step", **step_event)
         # chaos hook AFTER the event: a kill rule at step N must leave
         # step N's completion in the log before the process dies; a
         # slow rule stretches the observable step time (straggler)

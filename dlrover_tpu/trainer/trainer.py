@@ -186,10 +186,17 @@ class Trainer:
         )
         while step < self.args.max_steps:
             step_start = time.perf_counter()
-            placed = self._accel.place_batch(batch)
-            self._accel.state, metrics = self._accel.train_step(
-                self._accel.state, placed
-            )
+            # full phase breakdown for the diagnosis layer: the
+            # built-in loop previously profiled nothing, so a
+            # data-starved vs h2d-bound vs compute-bound recipe was
+            # indistinguishable from the step_phases event alone
+            with self._elastic.profile("h2d"):
+                placed = self._accel.place_batch(batch)
+            with self._elastic.profile("compute") as phase:
+                self._accel.state, metrics = self._accel.train_step(
+                    self._accel.state, placed
+                )
+                phase.block(metrics)
             step += 1
             loss = float(metrics["loss"])
             # float(loss) synced the step, so this is dispatch+sync
@@ -204,15 +211,18 @@ class Trainer:
                     "step %s loss %.4f grad_norm %.3f",
                     step, loss, float(metrics["grad_norm"]),
                 )
-            if self.args.save_steps and step % self.args.save_steps == 0:
-                self._save(step, step % save_storage_steps == 0)
+            with self._elastic.profile("checkpoint"):
+                if (self.args.save_steps
+                        and step % self.args.save_steps == 0):
+                    self._save(step, step % save_storage_steps == 0)
             if self.args.eval_steps and step % self.args.eval_steps == 0:
                 metrics_out["eval_loss"] = self.evaluate()
-            try:
-                batch = next(data_iter)
-            except StopIteration:
-                data_iter = iter(self.train_data)
-                batch = next(data_iter)
+            with self._elastic.profile("data_wait"):
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    data_iter = iter(self.train_data)
+                    batch = next(data_iter)
         # final storage save; flush in-flight snapshots first so the
         # save cannot be skipped as busy, then flush it too so a
         # process exit right after train() cannot lose it

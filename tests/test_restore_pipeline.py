@@ -289,6 +289,132 @@ def test_load_sharded_pipeline_reshard_bit_identical(
     engine.close()
 
 
+@pytest.mark.parametrize(
+    "save_mesh,restore_mesh",
+    [
+        # (device_count, axis shape) grids: N-shard save -> M-shard
+        # restore must be bit-identical to the unsharded state for
+        # every combination, including identity and the elastic
+        # 2 -> 1 shapes
+        ((8,), (4,)),
+        ((4,), (8,)),
+        ((2,), (1,)),
+        ((1,), (2,)),
+        ((8,), (2, 4)),
+        ((2, 4), (8,)),
+        ((4,), (4,)),
+    ],
+)
+def test_reshard_save_restore_grid_bit_identical(
+    saver, tmp_path, save_mesh, restore_mesh
+):
+    """Elastic-resize property (ISSUE 8 satellite): save under mesh
+    (N, shards) -> restore under mesh (M, shards') is bit-identical
+    to the unsharded source state, for a grid of N/M combinations.
+    Exercises assemble_target_pieces/commit_target_pieces with
+    genuinely different save-time and restore-time device index
+    maps."""
+    rng = np.random.default_rng(11)
+    src = rng.normal(size=(64, 8)).astype(np.float32)
+
+    axes_of = {1: ("a",), 2: ("a", "b")}
+    m1 = _mesh(save_mesh, axes_of[len(save_mesh)])
+    spec1 = P(*axes_of[len(save_mesh)]) if len(save_mesh) > 1 else P("a")
+    state = {
+        "w": jax.device_put(
+            jnp.asarray(src), NamedSharding(m1, spec1)
+        ),
+        "step": 3,
+    }
+    engine = _engine(tmp_path)
+    engine.replicated = False
+    assert engine.save_to_memory(3, state)
+
+    m2 = _mesh(restore_mesh, axes_of[len(restore_mesh)])
+    spec2 = (
+        P(*axes_of[len(restore_mesh)])
+        if len(restore_mesh) > 1 else P("a")
+    )
+    target = {
+        "w": jax.device_put(
+            jnp.zeros((64, 8)), NamedSharding(m2, spec2)
+        ),
+        "step": 0,
+    }
+    step, restored = engine.load_sharded(target)
+    assert step == 3
+    assert np.asarray(restored["w"]).tobytes() == src.tobytes()
+    assert restored["w"].sharding.is_equivalent_to(
+        target["w"].sharding, 2
+    )
+    assert restored["step"] == 3
+    engine.close()
+
+
+def test_reshard_round_trip_2_1_2(saver, tmp_path):
+    """The elastic churn arc in miniature: save sharded over 2
+    devices -> restore+resave over 1 -> restore over 2 again, every
+    hop from the STORAGE tier (the cross-world path: shm snapshots
+    from another world size are refused), final bytes identical to
+    the source."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+    rng = np.random.default_rng(13)
+    src = rng.normal(size=(32, 4)).astype(np.float32)
+
+    def sharded(ndev, arr):
+        m = _mesh((ndev,), ("a",))
+        return jax.device_put(
+            jnp.asarray(arr), NamedSharding(m, P("a"))
+        )
+
+    def engine_for(world):
+        e = CheckpointEngine(
+            str(tmp_path), replicated=False, local_rank=0,
+            global_rank=0, world_size=world,
+        )
+        return e
+
+    def wait_commit(step):
+        tracker = os.path.join(
+            str(tmp_path), CheckpointConstant.TRACKER_FILE
+        )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                with open(tracker) as f:
+                    if int(f.read().strip() or -1) >= step:
+                        return
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        raise AssertionError(f"step {step} never committed")
+
+    e2 = engine_for(2)
+    assert e2.save_to_storage(1, {"w": sharded(2, src)})
+    assert e2.wait_async(timeout=30)
+    wait_commit(1)
+
+    e1 = engine_for(1)
+    step, got = e1.load_sharded({"w": sharded(1, np.zeros_like(src))})
+    assert step == 1
+    assert e1.last_restore_phases["tier"] == "storage"
+    assert np.asarray(got["w"]).tobytes() == src.tobytes()
+    assert e1.save_to_storage(2, {"w": got["w"]})
+    assert e1.wait_async(timeout=30)
+    wait_commit(2)
+
+    e2b = engine_for(2)
+    step, back = e2b.load_sharded(
+        {"w": sharded(2, np.zeros_like(src))}
+    )
+    assert step == 2
+    assert e2b.last_restore_phases["tier"] == "storage"
+    assert np.asarray(back["w"]).tobytes() == src.tobytes()
+    for e in (e2, e1, e2b):
+        e.close()
+
+
 def test_restore_span_and_event_carry_stage_breakdown(
     saver, tmp_path, monkeypatch
 ):
