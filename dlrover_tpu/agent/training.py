@@ -675,6 +675,24 @@ class ElasticTrainingAgent:
             name="shm-prefetch",
         ).start()
 
+    def _observed_step(self) -> Optional[int]:
+        """Worker step this agent last saw in the trainer-written
+        metrics record — chaos-hook context only (None outside an
+        armed scenario: the production monitor poll must not pay a
+        file read for an unarmed hook)."""
+        if not _chaos.chaos_enabled():
+            return None
+        from dlrover_tpu.agent.monitor import read_metrics_record
+
+        record = read_metrics_record(
+            TrainingMonitor.default_metrics_path()
+        ) or {}
+        try:
+            step = int(record.get("global_step", -1))
+        except (TypeError, ValueError):
+            return None
+        return step if step >= 0 else None
+
     def _pop_master_action(self) -> str:
         """Consume the action the master piggybacked on the last
         heartbeat ack (the diagnosis chain's culprit-only relaunch
@@ -695,11 +713,16 @@ class ElasticTrainingAgent:
             # chaos hook: a kill_worker rule signals one of the
             # supervised processes here, and THIS VERY POLL observes
             # the death — the recovery path under test is the real
-            # monitor/restart machinery, not a shortcut
+            # monitor/restart machinery, not a shortcut.  The step
+            # this agent last saw in the trainer's metrics record
+            # rides in ctx so after_step rules ("kill node N once it
+            # trained past step K") trigger on real progress instead
+            # of wall clock, however slow the job's startup is.
             _chaos.fire(
                 "agent.monitor",
                 procs=self._procs,
                 restart_count=self._restart_count,
+                step=self._observed_step(),
             )
             action = self._pop_master_action()
             if action == MasterAction.RESTART_WORKERS:

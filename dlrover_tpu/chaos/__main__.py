@@ -96,11 +96,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     nnodes = args.nnodes
     if nnodes <= 1 and scenario.name in (
         "multinode-rpc-partition", "multinode-hang-culprit",
-        "elastic-resize-churn",
+        "elastic-resize-churn", "sparse-resize-churn",
     ):
         # the subset-fault scenarios are meaningless single-node
         nnodes = 2
-    if scenario.name == "elastic-resize-churn":
+    if scenario.name in (
+        "elastic-resize-churn", "sparse-resize-churn",
+    ):
         # needs the elastic runner: a min_nodes<nnodes master, a
         # shared checkpoint dir, and the replacement-agent respawn
         report = harness.run_elastic_resize_scenario(

@@ -41,9 +41,12 @@ from dlrover_tpu.chaos.scenarios import (
     RESIZE_TRAIN_SCRIPT,
     RUN_OPTIONS,
     SHARD_DATASET_ENV,
+    SPARSE_RESIZE_TRAIN_SCRIPT,
+    SPARSE_TRAIN_SCRIPT,
     STEP_SLEEP_ENV,
     TOTAL_STEPS_ENV,
     resize_reference_losses,
+    sparse_reference_losses,
 )
 from dlrover_tpu.chaos.schedule import Scenario, load_scenario
 from dlrover_tpu.common.env_utils import proc_stat_fields
@@ -56,6 +59,16 @@ from dlrover_tpu.telemetry.events import (
 )
 
 CHAOS_EVENT = "chaos_inject"
+
+# toy train loops a scenario can select via RUN_OPTIONS["train_script"]
+# (single-node harness defaults to the GPT loop, the resize harness to
+# the GSPMD resize loop)
+TRAIN_SCRIPTS = {
+    "default": CHAOS_TRAIN_SCRIPT,
+    "sparse": SPARSE_TRAIN_SCRIPT,
+    "resize": RESIZE_TRAIN_SCRIPT,
+    "sparse_resize": SPARSE_RESIZE_TRAIN_SCRIPT,
+}
 
 
 @dataclass
@@ -667,6 +680,215 @@ class RestoredFromTier(Invariant):
             self.name, True,
             f"restored from {self.tier!r} tier (step "
             f"{restores[0].get('step')})",
+        )
+
+
+def _kv_events(events: List[dict], stage: str) -> List[dict]:
+    return [
+        e for e in events
+        if e.get("type") == "kv_checkpoint" and e.get("stage") == stage
+    ]
+
+
+class KvStateRoundTrip(Invariant):
+    """Sparse state is bit-identical through the kill/restore cycle,
+    decided from telemetry alone: the first post-fault kv restore's
+    per-table content digests (keys + values + frequency counters +
+    optimizer slot tables) equal the digests the matching export
+    stamped before the fault.  Requires ``DLROVER_KV_DIGEST`` armed
+    in the run."""
+
+    name = "kv_state_round_trip"
+
+    def check(self, events, run):
+        fault_ts = _first_fault_ts(events)
+        if fault_ts is None:
+            return InvariantResult(
+                self.name, False, "no chaos_inject event recorded"
+            )
+        restores = [
+            e for e in _kv_events(events, "restore")
+            if e["ts"] >= fault_ts
+        ]
+        if not restores:
+            return InvariantResult(
+                self.name, False, "no kv restore after the fault"
+            )
+        restore = restores[0]
+        digests = restore.get("digests")
+        if not digests:
+            return InvariantResult(
+                self.name, False,
+                "kv restore carries no digests "
+                "(DLROVER_KV_DIGEST not armed?)",
+            )
+        step = restore.get("step")
+        exports = [
+            e for e in _kv_events(events, "export")
+            if e.get("step") == step and e.get("digests")
+            and e["ts"] <= restore["ts"]
+        ]
+        if not exports:
+            return InvariantResult(
+                self.name, False,
+                f"no digested kv export at restored step {step}",
+            )
+        expected = exports[-1]["digests"]
+        if expected != digests:
+            diff = sorted(
+                t for t in set(expected) | set(digests)
+                if expected.get(t) != digests.get(t)
+            )
+            return InvariantResult(
+                self.name, False,
+                f"digest mismatch at step {step} for table(s) {diff}: "
+                f"exported {expected} != restored {digests}",
+            )
+        rows = sum(int(d.get("rows", 0)) for d in digests.values())
+        return InvariantResult(
+            self.name, True,
+            f"{len(digests)} table(s), {rows} row(s) bit-identical "
+            f"through the cycle at step {step}",
+        )
+
+
+class SpillBreakerTripped(Invariant):
+    """The injected spill-tier fault tripped the PRODUCTION
+    write-failure breaker (not just the export skip): some post-fault
+    kv export event carries ``spill_disabled`` — the stat the tables
+    write through to telemetry when the cold tier is taken offline."""
+
+    name = "spill_breaker_tripped"
+
+    def check(self, events, run):
+        fault_ts = _first_fault_ts(events)
+        if fault_ts is None:
+            return InvariantResult(
+                self.name, False, "no chaos_inject event recorded"
+            )
+        hits = [
+            e for e in _kv_events(events, "export")
+            if e["ts"] >= fault_ts and e.get("spill_disabled")
+        ]
+        if not hits:
+            return InvariantResult(
+                self.name, False,
+                "no post-fault kv export reports spill_disabled — "
+                "the breaker never tripped",
+            )
+        lost = max(int(e.get("lost_rows", 0)) for e in hits)
+        return InvariantResult(
+            self.name, True,
+            f"breaker tripped ({len(hits)} export(s) with the cold "
+            f"tier offline, up to {lost} stranded row(s) skipped)",
+        )
+
+
+class KvReshardExactlyOnce(Invariant):
+    """Cross-world sparse restores redistribute the hash table
+    EXACTLY ONCE, decided from events alone.  For every resharded
+    restore generation (grouped by restored step + new world size):
+
+    - the per-rank imported row counts sum to the distinct union of
+      the old world's rows (``total_rows``, which every participant
+      must agree on) — no row lost, none imported twice;
+    - per table, the restore digests (additive across disjoint
+      shards) sum — mod 2**64 — to the sum of the old ranks' export
+      digests at that step: the redistributed CONTENT is the old
+      content, bit for bit.
+    """
+
+    name = "kv_reshard_exactly_once"
+
+    def __init__(self, min_reshards: int = 2):
+        self.min_reshards = min_reshards
+
+    @staticmethod
+    def _sum64(hexes: List[str]) -> int:
+        total = 0
+        for h in hexes:
+            total = (total + int(h, 16)) % (1 << 64)
+        return total
+
+    def check(self, events, run):
+        groups: Dict[tuple, Dict[int, dict]] = {}
+        for e in _kv_events(events, "restore"):
+            if not e.get("resharded"):
+                continue
+            key = (e.get("step"), e.get("world_size"))
+            # one record per (group, rank): retries keep the last
+            groups.setdefault(key, {})[e.get("rank")] = e
+        if len(groups) < self.min_reshards:
+            return InvariantResult(
+                self.name, False,
+                f"only {len(groups)} resharded restore generation(s) "
+                f"(need {self.min_reshards}): {sorted(groups)}",
+            )
+        # last digested export per (step, rank)
+        exports: Dict[tuple, dict] = {}
+        for e in _kv_events(events, "export"):
+            if e.get("digests") and e.get("step") is not None:
+                exports[(e["step"], e.get("rank", 0))] = e
+        problems = []
+        detail = []
+        for (step, world), by_rank in sorted(groups.items()):
+            recs = list(by_rank.values())
+            totals = {int(r.get("total_rows", -1)) for r in recs}
+            if len(totals) != 1:
+                problems.append(
+                    f"step {step}->world {world}: ranks disagree on "
+                    f"total_rows {sorted(totals)}"
+                )
+                continue
+            total_rows = totals.pop()
+            got_rows = sum(int(r.get("rows", 0)) for r in recs)
+            if got_rows != total_rows:
+                problems.append(
+                    f"step {step}->world {world}: imported "
+                    f"{got_rows} != union {total_rows} row(s)"
+                )
+                continue
+            src = [
+                exp for (s, _r), exp in exports.items() if s == step
+            ]
+            if not src:
+                problems.append(
+                    f"step {step}: no digested source exports"
+                )
+                continue
+            tables = set()
+            for r in recs:
+                tables |= set(r.get("digests") or {})
+            bad_tables = []
+            for table in sorted(tables):
+                want = self._sum64([
+                    exp["digests"][table]["sum"]
+                    for exp in src if table in exp["digests"]
+                ])
+                got = self._sum64([
+                    r["digests"][table]["sum"]
+                    for r in recs if table in (r.get("digests") or {})
+                ])
+                if want != got:
+                    bad_tables.append(table)
+            if bad_tables:
+                problems.append(
+                    f"step {step}->world {world}: digest sums "
+                    f"diverge for table(s) {bad_tables}"
+                )
+                continue
+            detail.append(
+                f"step {step}->world {world}: {total_rows} row(s) "
+                f"across {len(recs)} rank(s)"
+            )
+        if problems:
+            return InvariantResult(
+                self.name, False, "; ".join(problems)
+            )
+        return InvariantResult(
+            self.name, True,
+            f"{len(detail)} exactly-once reshard(s): "
+            + "; ".join(detail),
         )
 
 
@@ -1300,6 +1522,33 @@ def invariants_for_scenario(
             ),
             NoOrphanProcesses(marker=workdir),
         ]
+    if name == "sparse-kill-restore":
+        # the sparse acceptance trail: full recovery set + the loss
+        # trajectory equal to the uninterrupted DeepFM control + the
+        # kv digests proving rows/freq/slots bit-identical through
+        # the cycle — the latter two are what make it SPARSE recovery
+        return default_invariants(
+            total_steps, ckpt_every, workdir
+        ) + [
+            LossTrajectoryMatches(
+                sparse_reference_losses(total_steps)
+            ),
+            KvStateRoundTrip(),
+        ]
+    if name == "sparse-spill-io-error":
+        # no loss-trajectory assertion: rows stranded on the dead
+        # spill disk are LOST by design — the contract is graceful
+        # degradation (breaker trips, DRAM rows commit, the restore
+        # round-trips exactly what the post-fault export contains)
+        return [
+            WorkerRestarted(),
+            RendezvousReconverged(),
+            BoundedStepLoss(ckpt_interval=ckpt_every),
+            SpillBreakerTripped(),
+            KvStateRoundTrip(),
+            TrainingCompleted(total_steps=total_steps),
+            NoOrphanProcesses(marker=workdir),
+        ]
     if name in RECOVERY_SCENARIOS:
         return default_invariants(total_steps, ckpt_every, workdir)
     return [
@@ -1349,7 +1598,7 @@ def run_scenario(
         json.dump(scenario.to_dict(), f, indent=2)
     script = os.path.join(workdir, "chaos_train.py")
     with open(script, "w") as f:
-        f.write(CHAOS_TRAIN_SCRIPT)
+        f.write(TRAIN_SCRIPTS[opts.get("train_script", "default")])
     event_log = os.path.join(workdir, "events.jsonl")
     ckpt_dir = os.path.join(workdir, "ckpt")
 
@@ -1651,6 +1900,29 @@ def elastic_resize_invariants(
     ]
 
 
+def sparse_resize_invariants(
+    nnodes: int, total_steps: int, disk_every: int, workdir: str,
+    dim: int = 64,
+) -> List[Invariant]:
+    """The sparse elastic-resize acceptance set: everything the dense
+    resize proves about the world trajectory / storage-tier reshard /
+    loss control, PLUS exactly-once redistribution of the hash-table
+    rows across both world changes (kv digests additive across
+    disjoint shards)."""
+    return [
+        WorldSizeTrajectory([nnodes, nnodes - 1, nnodes]),
+        EventRecorded("resize_decision", min_count=2),
+        RestoredFromTier("storage"),
+        LossTrajectoryMatches(
+            resize_reference_losses(total_steps, dim=dim)
+        ),
+        BoundedStepLossPerRestart(interval=disk_every),
+        KvReshardExactlyOnce(min_reshards=2),
+        FinalStepCommitted(),
+        NoOrphanProcesses(marker=workdir),
+    ]
+
+
 def run_elastic_resize_scenario(
     scenario,
     workdir: str,
@@ -1694,7 +1966,7 @@ def run_elastic_resize_scenario(
         json.dump(scenario.to_dict(), f, indent=2)
     script = os.path.join(workdir, "resize_train.py")
     with open(script, "w") as f:
-        f.write(RESIZE_TRAIN_SCRIPT)
+        f.write(TRAIN_SCRIPTS[opts.get("train_script", "resize")])
     event_log = os.path.join(workdir, "events.jsonl")
     agent_event_glob = os.path.join(workdir, "events_node*.jsonl")
     ckpt_dir = os.path.join(workdir, "ckpt")  # SHARED across nodes
@@ -1885,9 +2157,14 @@ def run_elastic_resize_scenario(
         scenario, rc, workdir, event_log,
         extra_sources=[agent_event_glob],
     )
+    default_set = (
+        sparse_resize_invariants
+        if opts.get("train_script") == "sparse_resize"
+        else elastic_resize_invariants
+    )
     checks = (
         invariants if invariants is not None
-        else elastic_resize_invariants(
+        else default_set(
             nnodes, total_steps, disk_every, workdir,
         )
     )

@@ -368,6 +368,344 @@ ckpt.close()
 '''
 
 
+# Sparse elastic train loop (ISSUE 9): a DeepFM job whose embedding
+# lives in a host KvVariable table (GroupAdam slot tables riding
+# along, spill tier armed when DLROVER_CHAOS_KV_SPILL sets a DRAM
+# budget).  The SparseStateAdapter registers the tables with the
+# flash-checkpoint engine, so every save snapshots keys/values/freq +
+# optimizer slots into the shm segment next to the dense state, and a
+# restore imports them back bit-exact.  The batch at step k is a pure
+# function of k, so :func:`sparse_reference_losses` recomputes the
+# uninterrupted control in-process and the harness compares every
+# reported loss against it — a restore that lost an embedding row,
+# a frequency count or an Adam moment forks the trajectory at the
+# first replayed step.  argv: ckpt_dir
+SPARSE_TRAIN_SCRIPT = r'''
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.checkpoint.checkpointer import (
+    Checkpointer, StorageType, restore_to_template,
+)
+from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+from dlrover_tpu.trainer.sparse_pipeline import make_deepfm_device_step
+from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+ckpt_dir = sys.argv[1]
+TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "12"))
+CKPT_EVERY = int(os.environ.get("DLROVER_CHAOS_CKPT_EVERY", "2"))
+DISK_EVERY = int(os.environ.get("DLROVER_CHAOS_DISK_EVERY", "0"))
+STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
+KV_SPILL = int(os.environ.get("DLROVER_CHAOS_KV_SPILL", "0"))
+
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+
+def committed_step():
+    try:
+        with open(tracker) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
+
+# MUST mirror scenarios.sparse_reference_losses exactly
+cfg = DeepFMConfig(num_sparse_fields=6, num_dense_features=4,
+                   embedding_dim=8, hidden_dims=(16,), seed=5)
+model = DeepFM(cfg)
+if KV_SPILL:
+    # node-local spill files next to (not inside) the shared ckpt dir;
+    # O_TRUNC on re-open wipes a dead predecessor's file
+    spill_dir = os.path.join(os.path.dirname(ckpt_dir), "kvspill")
+    os.makedirs(spill_dir, exist_ok=True)
+    model.table.enable_spill(
+        os.path.join(spill_dir, "emb.spill"), KV_SPILL
+    )
+    model.sparse_optimizer.enable_spill(spill_dir, KV_SPILL)
+
+dense_opt = optax.adam(1e-2)
+adapter = SparseStateAdapter()
+adapter.register_optimizer(model.sparse_optimizer)
+ckpt = Checkpointer(ckpt_dir)
+ckpt.register_sparse(adapter)
+
+params = model.init_dense_params()
+opt_state = dense_opt.init(params)
+start_step, restored = ckpt.load_checkpoint()
+if start_step is None:
+    start_step = 0
+else:
+    # dense params AND optax state restored typed; the kv tables were
+    # already imported by the engine through the adapter
+    params, opt_state = restore_to_template(
+        (params, opt_state), restored["dense"]
+    )
+state = (params, opt_state)
+device_step = make_deepfm_device_step(model, dense_opt)
+
+trainer = ElasticTrainer(global_batch_size=16, micro_batch_size=16,
+                         dp_size=1)
+trainer.global_step = start_step
+
+def batch_for(k):
+    rng = np.random.default_rng(10_000 + k)
+    sparse = rng.integers(
+        0, 4000, (16, cfg.num_sparse_fields)
+    ).astype(np.int64)
+    dense = rng.normal(
+        size=(16, cfg.num_dense_features)
+    ).astype(np.float32)
+    labels = (sparse[:, 0] % 2).astype(np.float32)
+    return sparse, dense, labels
+
+for k in range(start_step, TOTAL_STEPS):
+    sparse_ids, dense_x, labels = batch_for(k)
+    with trainer.profile("h2d"):
+        emb = jnp.asarray(model.gather_embeddings(sparse_ids))
+        dx, lb = jnp.asarray(dense_x), jnp.asarray(labels)
+    with trainer.profile("compute") as p:
+        state, egrads, aux = device_step(state, emb, dx, lb)
+        p.block(aux["loss"])
+    # strict split step: the sparse update retires before the step is
+    # reported, so a checkpoint taken after the report is exactly
+    # step-consistent across dense AND host-table state
+    model.apply_sparse_gradients(sparse_ids, np.asarray(egrads))
+    trainer.report_step({"loss": float(aux["loss"])})
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+    with trainer.profile("checkpoint"):
+        sd = {"dense": state, "trainer": trainer.state_dict()}
+        if DISK_EVERY and trainer.global_step % DISK_EVERY == 0:
+            ckpt.save_checkpoint(
+                trainer.global_step, sd,
+                storage_type=StorageType.DISK,
+            )
+            ckpt.wait()
+            deadline = time.time() + 30
+            while (time.time() < deadline
+                   and committed_step() < trainer.global_step):
+                time.sleep(0.1)
+        elif trainer.global_step % CKPT_EVERY == 0:
+            ckpt.save_checkpoint(
+                trainer.global_step, sd,
+                storage_type=StorageType.MEMORY,
+            )
+
+final_sd = {"dense": state, "trainer": trainer.state_dict()}
+deadline = time.time() + 60
+while time.time() < deadline and committed_step() < TOTAL_STEPS:
+    ckpt.save_checkpoint(
+        TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+    )
+    ckpt.wait()
+    poll_end = time.time() + 10
+    while time.time() < poll_end and committed_step() < TOTAL_STEPS:
+        time.sleep(0.2)
+assert committed_step() >= TOTAL_STEPS, (
+    "checkpoint commit did not land"
+)
+ckpt.close()
+'''
+
+
+def sparse_reference_losses(total_steps: int):
+    """Uninterrupted-control loss trajectory of
+    :data:`SPARSE_TRAIN_SCRIPT`, computed in-process: same DeepFM
+    config/seeds, same step-indexed batches, same strict split-step
+    order.  ``result[k-1]`` is the loss step ``k`` must report
+    regardless of kills and flash restores — a restore that dropped
+    an embedding row, a frequency count, an optimizer slot or the
+    Adam step counter forks the trajectory at the first replayed
+    step."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+    from dlrover_tpu.trainer.sparse_pipeline import (
+        make_deepfm_device_step,
+    )
+
+    cfg = DeepFMConfig(num_sparse_fields=6, num_dense_features=4,
+                       embedding_dim=8, hidden_dims=(16,), seed=5)
+    model = DeepFM(cfg)
+    dense_opt = optax.adam(1e-2)
+    params = model.init_dense_params()
+    state = (params, dense_opt.init(params))
+    device_step = make_deepfm_device_step(model, dense_opt)
+    out = []
+    for k in range(total_steps):
+        rng = np.random.default_rng(10_000 + k)
+        sparse = rng.integers(
+            0, 4000, (16, cfg.num_sparse_fields)
+        ).astype(np.int64)
+        dense = rng.normal(
+            size=(16, cfg.num_dense_features)
+        ).astype(np.float32)
+        labels = (sparse[:, 0] % 2).astype(np.float32)
+        emb = jnp.asarray(model.gather_embeddings(sparse))
+        state, egrads, aux = device_step(
+            state, emb, jnp.asarray(dense), jnp.asarray(labels)
+        )
+        model.apply_sparse_gradients(sparse, np.asarray(egrads))
+        out.append(float(aux["loss"]))
+    return out
+
+
+# Sparse elastic world-resize loop: RESIZE_TRAIN_SCRIPT's GSPMD dense
+# leg (lockstep collectives, loss == the uninterrupted control at any
+# world size) PLUS a KvVariable embedding partitioned across the
+# world by the SAME key hash the cross-world reshard uses
+# (checkpoint.sparse.owner_of_keys) — so a 2->1->2 churn genuinely
+# redistributes hash-table rows from committed storage, exactly once,
+# provable from the kv_checkpoint digests.  argv: ckpt_dir (SHARED).
+SPARSE_RESIZE_TRAIN_SCRIPT = r'''
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.checkpoint.sparse import (
+    SparseStateAdapter, owner_of_keys,
+)
+from dlrover_tpu.ops.kv_variable import GroupAdamOptimizer, KvVariable
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticTrainer, init_jax_distributed,
+)
+
+ckpt_dir = sys.argv[1]
+TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "24"))
+DISK_EVERY = int(os.environ.get("DLROVER_CHAOS_DISK_EVERY", "3"))
+STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
+DIM = int(os.environ.get("DLROVER_CHAOS_RESIZE_DIM", "64"))
+
+WORLD = int(os.environ.get("DLROVER_WORLD_SIZE", "1") or 1)
+RANK = int(os.environ.get("DLROVER_RANK", "0") or 0)
+
+init_jax_distributed()
+devs = jax.devices()
+mesh = Mesh(np.array(devs), ("fsdp",))
+shard = NamedSharding(mesh, P("fsdp"))
+
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+
+def committed_step():
+    try:
+        with open(tracker) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
+
+def make_sharded(global_np):
+    arrs = [
+        jax.device_put(np.ascontiguousarray(global_np[index]), d)
+        for d, index in shard.addressable_devices_indices_map(
+            global_np.shape
+        ).items()
+    ]
+    return jax.make_array_from_single_device_arrays(
+        global_np.shape, shard, arrs
+    )
+
+# host-table sparse state, hash-partitioned across the world: this
+# rank's table holds ONLY the keys owner_of_keys assigns it, so each
+# rank's checkpoint shard is a distinct slice of the logical table
+# and a world change must genuinely redistribute rows
+table = KvVariable(dim=8, seed=17, name="emb")
+kv_opt = GroupAdamOptimizer(table, learning_rate=5e-3)
+adapter = SparseStateAdapter()
+adapter.register_optimizer(kv_opt)
+
+template = make_sharded(np.zeros((DIM, 8), np.float32))
+ckpt = Checkpointer(ckpt_dir, replicated=False)
+ckpt.register_sparse(adapter)
+# cross-world restores refuse the shm tier and reshard BOTH the dense
+# GSPMD shards and the kv rows from committed storage
+step0, restored = ckpt.load_checkpoint(target_state={"w": template})
+if step0 is None:
+    start_step, w = 0, template
+else:
+    start_step, w = int(step0), restored["w"]
+
+# dense leg MUST mirror scenarios.resize_reference_losses exactly
+@jax.jit
+def step_fn(w, k):
+    x = jax.random.normal(
+        jax.random.fold_in(jax.random.PRNGKey(1000), k),
+        (8,), jnp.float32,
+    )
+    def loss_fn(w):
+        return ((w @ x - 1.0) ** 2).mean()
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - 0.1 * g, loss
+
+trainer = ElasticTrainer(global_batch_size=8, micro_batch_size=8,
+                         dp_size=1)
+trainer.global_step = start_step
+
+for k in range(start_step, TOTAL_STEPS):
+    # sparse leg: a step-indexed global key stream, routed to this
+    # rank by the same owner hash the reshard partitions with; the
+    # per-row update depends only on the row's own state, so row
+    # trajectories are world-size-independent
+    krng = np.random.default_rng(5_000 + k)
+    gkeys = krng.integers(0, 3_000, 48).astype(np.int64)
+    mine = gkeys[owner_of_keys(gkeys, WORLD) == RANK]
+    if mine.size:
+        emb = table.gather(mine)
+        kv_opt.apply_gradients(mine, np.tanh(emb) * 0.1)
+    with trainer.profile("compute") as p:
+        w, loss = step_fn(w, k + 1)
+        p.block(loss)
+    trainer.report_step({"loss": float(loss)})
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+    with trainer.profile("checkpoint"):
+        if DISK_EVERY and trainer.global_step % DISK_EVERY == 0:
+            ckpt.save_checkpoint(
+                trainer.global_step, {"w": w},
+                storage_type=StorageType.DISK,
+            )
+            ckpt.wait()
+            deadline = time.time() + 30
+            while (time.time() < deadline
+                   and committed_step() < trainer.global_step):
+                time.sleep(0.1)
+        else:
+            ckpt.save_checkpoint(
+                trainer.global_step, {"w": w},
+                storage_type=StorageType.MEMORY,
+            )
+
+final_sd = {"w": w}
+if RANK == 0:
+    deadline = time.time() + 60
+    while time.time() < deadline and committed_step() < TOTAL_STEPS:
+        ckpt.save_checkpoint(
+            TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+        )
+        ckpt.wait()
+        poll_end = time.time() + 10
+        while time.time() < poll_end and committed_step() < TOTAL_STEPS:
+            time.sleep(0.2)
+    assert committed_step() >= TOTAL_STEPS, (
+        "checkpoint commit did not land"
+    )
+else:
+    ckpt.save_checkpoint(
+        TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+    )
+    ckpt.wait()
+ckpt.close()
+'''
+
+
 def resize_reference_losses(total_steps: int, dim: int = 64):
     """Uninterrupted-control loss trajectory of
     :data:`RESIZE_TRAIN_SCRIPT`'s update rule, computed single-device
@@ -795,6 +1133,90 @@ def multinode_hang_culprit(seed: int = 59) -> Scenario:
     })
 
 
+def sparse_kill_restore(seed: int = 61) -> Scenario:
+    """Sparse elastic recovery acceptance (ISSUE 9): SIGKILL a DeepFM
+    job mid-run — embedding table, frequency counters and GroupAdam
+    slot tables (spill tier ACTIVE: the harness arms a DRAM budget so
+    real rows live on the cold tier) must ride the flash checkpoint
+    and come back bit-identical: the restored incarnation's loss
+    trajectory equals the uninterrupted control, and the
+    ``kv_checkpoint`` digests prove every row/freq/slot survived —
+    all decided from telemetry events alone."""
+    return Scenario.from_dict({
+        "name": "sparse-kill-restore",
+        "seed": seed,
+        "rules": [{
+            "name": "kill-sparse-midstep",
+            "point": "trainer.step",
+            "action": "kill",
+            "step_window": [5, 7],
+            "only_first_incarnation": True,
+        }],
+    })
+
+
+def sparse_spill_io_error(seed: int = 67) -> Scenario:
+    """Graceful degradation (ISSUE 9): the spill tier's disk dies
+    DURING a checkpoint export (io_error on the ``kv.spill`` hook).
+    Stranded cold rows drop out of that export; training continues
+    and the production write-failure breaker trips on the next spill
+    pass (``spill_disabled`` on the following export event); the
+    checkpoint of the DRAM-resident rows still commits, and after a
+    kill two steps later the restore is valid — round-trip digests
+    still match the (post-fault) export."""
+    return Scenario.from_dict({
+        "name": "sparse-spill-io-error",
+        "seed": seed,
+        "rules": [
+            {
+                "name": "spill-disk-dies",
+                "point": "kv.spill",
+                "action": "io_error",
+                "at_step": 4,
+                "max_count": 1,
+                "only_first_incarnation": True,
+            },
+            {
+                "name": "kill-after-breaker",
+                "point": "trainer.step",
+                "action": "kill",
+                "at_step": 7,
+                "only_first_incarnation": True,
+            },
+        ],
+    })
+
+
+def sparse_resize_churn(seed: int = 71) -> Scenario:
+    """Sparse elastic world-resize (ISSUE 9 — the genuinely novel
+    combination with PR 8's ResizeCoordinator): a node loss shrinks a
+    two-node sparse job to one, and the hash-table embedding (plus
+    its optimizer slot tables) is RESHARDED from committed storage —
+    all old ranks' kv shards read, rows re-partitioned by key hash,
+    the owned subset imported — then the world grows back and
+    reshards again.  Exactly-once row accounting and the shm-tier
+    refusal across world sizes are decided from the ``kv_checkpoint``
+    events alone."""
+    return Scenario.from_dict({
+        "name": "sparse-resize-churn",
+        "seed": seed,
+        "rules": [{
+            "name": "node1-loss",
+            "point": "agent.monitor",
+            "action": "kill_node",
+            # progress-based, not wall-clock: the node dies only once
+            # its trainer has REPORTED past step 6 (two world-2 disk
+            # commits exist) — a slow jax/distributed startup cannot
+            # turn the scenario into train-from-scratch at world 1
+            "after_step": 6,
+            "env_equals": {
+                "DLROVER_NODE_RANK": "1",
+                "DLROVER_AGENT_RESPAWNED": "",
+            },
+        }],
+    })
+
+
 def shm_corruption(seed: int = 17) -> Scenario:
     """Tear one shm snapshot right after it is written (writing=True
     republish): the persist and restore paths must refuse the torn
@@ -831,6 +1253,9 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "trainer_hang_detected": trainer_hang_detected,
     "elastic_resize_churn": elastic_resize_churn,
     "multinode_hang_culprit": multinode_hang_culprit,
+    "sparse_kill_restore": sparse_kill_restore,
+    "sparse_spill_io_error": sparse_spill_io_error,
+    "sparse_resize_churn": sparse_resize_churn,
 }
 
 
@@ -938,6 +1363,55 @@ RUN_OPTIONS: Dict[str, Dict] = {
             "DLROVER_HANG_TIMEOUT": "3",
             "DLROVER_SECONDS_TO_CHECK_HANG": "0.5",
             "DLROVER_HANG_RESTART_GRACE_S": "20",
+        },
+    },
+    # sparse recovery: the toy DeepFM loop (train_script selects it in
+    # the harness), per-table content digests armed so the round-trip
+    # invariant can decide bit-identity from events alone, and a DRAM
+    # budget small enough that real rows live on the spill tier (the
+    # control runs DRAM-only — residence is transparent, values equal)
+    "sparse-kill-restore": {
+        "total_steps": 12,
+        "ckpt_every": 2,
+        "train_script": "sparse",
+        "extra_env": {
+            "DLROVER_KV_DIGEST": "1",
+            "DLROVER_CHAOS_KV_SPILL": "48",
+        },
+    },
+    # spill-disk death mid-export: same loop + budget; the kill lands
+    # at step 7 so the step-6 export (post-breaker, spill_disabled
+    # stamped) is the one the restore round-trips
+    "sparse-spill-io-error": {
+        "total_steps": 12,
+        "ckpt_every": 2,
+        "train_script": "sparse",
+        "extra_env": {
+            "DLROVER_KV_DIGEST": "1",
+            "DLROVER_CHAOS_KV_SPILL": "48",
+        },
+    },
+    # sparse resize: the elastic-resize recipe (same control-plane
+    # knobs as elastic-resize-churn) with the kv-partitioned loop and
+    # digests armed; disk commits every 3 steps bound the cross-world
+    # restore's step loss AND guarantee a world-1 commit exists
+    # before the harness respawns the replacement agent
+    "sparse-resize-churn": {
+        "total_steps": 24,
+        "disk_every": 3,
+        "step_sleep": 0.3,
+        "train_script": "sparse_resize",
+        "extra_env": {
+            "DLROVER_KV_DIGEST": "1",
+            "DLROVER_MONITOR_REPORT_INTERVAL": "0.5",
+            "DLROVER_HANG_DETECTION_S": "2.5",
+            "DLROVER_RESIZE_GRACE_S": "1.0",
+            "DLROVER_RESIZE_REDELIVER_S": "15.0",
+            "DLROVER_RESIZE_STOP_TIMEOUT_S": "1.5",
+            "DLROVER_SECONDS_TO_CHECK_HANG": "0.5",
+            "DLROVER_BREAKPOINT_COMMIT_TIMEOUT_S": "3",
+            "DLROVER_MEMBERSHIP_SELF_RESTART": "0",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
         },
     },
     # hang diagnosis in seconds instead of half an hour: fast step

@@ -14,6 +14,13 @@ Trigger vocabulary (one per rule; all composable with ``max_count``,
 
 - ``at_step: N``          — fires when the hook context carries
   ``step == N`` (trainer-side points).
+- ``after_step: N``       — fires once the hook context carries
+  ``step >= N``.  The progress-based alternative to ``after_time``
+  for SAMPLED step observations (the ``agent.monitor`` hook passes
+  the step it last saw in the trainer's metrics record — equality
+  can be skipped over, a threshold cannot), so "kill node 1 once it
+  has trained past step N" stays deterministic however slow the
+  job's startup is.
 - ``step_window: [lo, hi]`` — a step is drawn deterministically from
   the inclusive window using the rule's seeded RNG ("kill one worker
   mid-step with a fixed seed").
@@ -70,6 +77,7 @@ class Rule:
     action: str
     name: str = ""
     at_step: Optional[int] = None
+    after_step: Optional[int] = None
     step_window: Optional[List[int]] = None
     after_calls: Optional[int] = None
     after_time: Optional[float] = None
@@ -102,16 +110,16 @@ class Rule:
             )
         triggers = [
             t for t in (
-                self.at_step, self.step_window, self.after_calls,
-                self.after_time, self.prob,
+                self.at_step, self.after_step, self.step_window,
+                self.after_calls, self.after_time, self.prob,
             )
             if t is not None
         ]
         if len(triggers) > 1:
             raise ValueError(
                 f"rule {self.name or self.point!r} has more than one "
-                "trigger; pick one of at_step/step_window/after_calls/"
-                "after_time/prob"
+                "trigger; pick one of at_step/after_step/step_window/"
+                "after_calls/after_time/prob"
             )
         if self.step_window is not None:
             lo, hi = self.step_window
@@ -201,6 +209,9 @@ class RuleState:
         rule = self.rule
         if rule.at_step is not None:
             return ctx.get("step") == rule.at_step
+        if rule.after_step is not None:
+            step = ctx.get("step")
+            return step is not None and step >= rule.after_step
         if rule.step_window is not None:
             return ctx.get("step") == self.chosen_step
         if rule.after_calls is not None:
@@ -227,8 +238,8 @@ class Scenario:
         for r in self.rules:
             rd: Dict[str, Any] = {"point": r.point, "action": r.action}
             for key in (
-                "name", "at_step", "step_window", "after_calls",
-                "after_time", "prob", "incarnation",
+                "name", "at_step", "after_step", "step_window",
+                "after_calls", "after_time", "prob", "incarnation",
             ):
                 val = getattr(r, key)
                 if val not in (None, ""):

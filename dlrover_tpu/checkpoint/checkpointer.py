@@ -67,6 +67,16 @@ class Checkpointer:
             self._orbax = GlobalCheckpointer(self._orbax_dir)
         return self._orbax
 
+    def register_sparse(self, adapter) -> None:
+        """Attach a
+        :class:`~dlrover_tpu.checkpoint.sparse.SparseStateAdapter`:
+        the registered KvVariable tables (embedding + optimizer
+        slots, spill tier included) ride every save under the
+        reserved ``__kv__`` key and are imported — or, across a world
+        change, hash-resharded from all old ranks' storage shards —
+        on every restore."""
+        self._engine.register_sparse(adapter)
+
     def save_checkpoint(
         self,
         step: int,

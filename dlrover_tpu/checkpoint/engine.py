@@ -28,6 +28,7 @@ from dlrover_tpu.checkpoint.saver import (
 import numpy as np
 
 from dlrover_tpu.checkpoint.sharded import SHARD_SEP
+from dlrover_tpu.checkpoint.sparse import KV_STATE_KEY
 from dlrover_tpu.checkpoint.shm_handler import (
     CheckpointConfig,
     SharedMemoryHandler,
@@ -143,6 +144,12 @@ class CheckpointEngine:
             if self._local_rank == 0 else None
         )
         self._storage = get_checkpoint_storage(path=checkpoint_dir)
+        # sparse (KvVariable) state adapter: when registered, every
+        # save asks it for an export snapshot that rides the shm
+        # segment under the reserved "__kv__" key, and every restore
+        # imports (or cross-world reshards) the blobs back before the
+        # dense state is returned
+        self._sparse = None
         self._notified_agent = False
         self._deletion_keep_latest = deletion_keep_latest
         self._cached_step = -1
@@ -155,6 +162,47 @@ class CheckpointEngine:
     @property
     def global_shard_num(self) -> int:
         return 1 if self.replicated else self._world_size
+
+    def register_sparse(self, adapter) -> None:
+        """Attach a
+        :class:`~dlrover_tpu.checkpoint.sparse.SparseStateAdapter`:
+        its KvVariable tables become checkpoint state alongside the
+        dense pytree.  Requires dict-shaped state dicts (the blobs
+        nest under the reserved ``__kv__`` key)."""
+        if self.replicated and self._world_size > 1:
+            # replicated persists only rank 0's shard
+            # (global_shard_num=1): every other rank's kv rows would
+            # silently vanish on a storage-tier restore.
+            raise ValueError(
+                "sparse state requires per-rank shards: construct the "
+                "engine with replicated=False for world_size "
+                f"{self._world_size} (replicated=True persists only "
+                "rank 0, losing every other rank's kv rows)"
+            )
+        self._sparse = adapter
+
+    def _merge_sparse(self, state_dict, step: int):
+        """Fold the adapter's export snapshot into a COPY of the
+        state dict.  Runs synchronously with respect to table
+        mutation (before the async writer takes over), so the sparse
+        snapshot is consistent with the dense one: the save stall
+        grows only by the export memcpy — the tables are host RAM
+        already, there is no device fetch to wait on."""
+        if self._sparse is None:
+            return state_dict
+        if not isinstance(state_dict, dict):
+            raise TypeError(
+                "a sparse adapter requires a dict state_dict (the kv "
+                f"blobs ride under {KV_STATE_KEY!r}); got "
+                f"{type(state_dict).__name__}"
+            )
+        if KV_STATE_KEY in state_dict:
+            return state_dict
+        merged = dict(state_dict)
+        merged[KV_STATE_KEY] = self._sparse.export_state(
+            step=step, rank=self._rank
+        )
+        return merged
 
     def _notify_agent_to_create_saver(self):
         """Ship the saver config to the agent's factory queue once
@@ -218,6 +266,10 @@ class CheckpointEngine:
         path, so waiting for the agent is free and the save must not
         be silently dropped."""
         self._notify_agent_to_create_saver()
+        # sparse tables export here on the SYNC path (MEMORY saves /
+        # no-device-array states); the async path already merged a
+        # consistent export before queueing, which the key guard skips
+        state_dict = self._merge_sparse(state_dict, step)
         # every rank locks its shard: the agent's breakpoint save reads
         # all local shards, so an unlocked write can be torn even for
         # ranks that never persist to storage; without an agent there
@@ -384,6 +436,11 @@ class CheckpointEngine:
                 _SAVE_SKIPPED_TOTAL.inc(reason="writer_busy")
                 return False
             snap = self._device_snapshot(state_dict)
+            # sparse export joins the snapshot NOW — synchronous with
+            # respect to table mutation, like the on-device copy is
+            # for the dense leaves; the writer thread must not read a
+            # table the next train step is already scattering into
+            snap = self._merge_sparse(snap, step)
             # kick off the device->host transfers without blocking
             for leaf in jax.tree_util.tree_leaves(snap):
                 if isinstance(leaf, jax.Array):
@@ -450,7 +507,27 @@ class CheckpointEngine:
             stats = RestoreStats()
             t0 = time.perf_counter()
             config, state = self.get_state_dict_from_memory(stats)
+            if (
+                config is not None
+                and self._sparse is not None
+                and int(getattr(config, "world_size", 0) or 0)
+                != self._world_size
+            ):
+                # the dense cross-world rule applies to kv state too:
+                # an shm snapshot of another world is per-node state —
+                # sparse cross-world restores reshard the hash table
+                # from the globally COMMITTED storage tier
+                logger.warning(
+                    "shm snapshot is from world size %s but this "
+                    "world is %s; skipping the shm tier (sparse "
+                    "cross-world restores reshard from storage)",
+                    config.world_size, self._world_size,
+                )
+                config, state = None, {}
             if config is not None:
+                state = self._consume_sparse(
+                    state, stats, tier="shm", step=config.step
+                )
                 self._record_restore(
                     "shm", config.step, time.perf_counter() - t0,
                     stats.to_phases(), sp,
@@ -499,10 +576,46 @@ class CheckpointEngine:
             )
         return config, state
 
+    def _consume_sparse(self, state, stats, tier: str, step):
+        """Pop the ``__kv__`` subtree out of a restored (same-world)
+        state dict and import it into the registered tables; the kv
+        stage timings land in ``stats.extra`` so the restore event
+        and the timeline's restore slices show them."""
+        if self._sparse is None or not isinstance(state, dict):
+            return state
+        kv_state = state.pop(KV_STATE_KEY, None)
+        if kv_state is None:
+            logger.warning(
+                "sparse adapter registered but checkpoint step %s "
+                "carries no kv state; tables left untouched", step,
+            )
+            return state
+        info = self._sparse.import_state(
+            kv_state, tier=tier, step=step, rank=self._rank
+        )
+        stats.extra.update(info)
+        return state
+
+    def _checkpoint_world(self, meta) -> Optional[int]:
+        """World size stamped on a persisted shard's meta (the
+        CheckpointConfig every save publishes)."""
+        cfg = meta.get("config") if isinstance(meta, dict) else None
+        if cfg is None:
+            return None
+        return int(getattr(cfg, "world_size", 0) or 0) or None
+
     def load_from_storage(self, stats=None) -> Tuple[Optional[int], Any]:
         """Storage-tier restore: tracker -> this rank's shard, read
         as a lazy mmap view and detached through the chunked parallel
-        pipeline (page-in overlaps the copies)."""
+        pipeline (page-in overlaps the copies).
+
+        With a sparse adapter registered, every rank file is read:
+        same-world restores import this rank's own kv shard verbatim;
+        a WORLD CHANGE reshards — all old ranks' kv rows are
+        re-partitioned by key hash and this rank imports its owned
+        subset (the dense part then comes from the lowest surviving
+        rank, which is only meaningful for replicated dense state —
+        GSPMD jobs restore through :meth:`load_sharded`)."""
         from dlrover_tpu.checkpoint.restore import RestoreStats
 
         own = stats is None
@@ -516,6 +629,26 @@ class CheckpointEngine:
         )
         if step is None:
             return None, {}
+        if self._sparse is not None:
+            own_shard = shards.get(want_rank)
+            ckpt_world = (
+                self._checkpoint_world(own_shard[0])
+                if own_shard else None
+            )
+            if own_shard is None or ckpt_world != self._world_size:
+                # missing own shard or a world-stamp mismatch: only
+                # now pay the all-ranks read (a cross-world reshard
+                # needs every old rank's kv shard; the routine
+                # same-world restore above reads exactly one file)
+                step, shards = read_last_checkpoint(
+                    self.checkpoint_dir, self._storage, stats=stats,
+                )
+                if step is None:
+                    return None, {}
+            if shards:
+                return self._load_sparse_from_storage(
+                    step, shards, want_rank, stats, t0, own
+                )
         if want_rank not in shards:
             logger.error(
                 "checkpoint step %s has no shard for rank %s "
@@ -525,6 +658,79 @@ class CheckpointEngine:
             return None, {}
         meta, raw = shards[want_rank]
         state = state_dict_from_raw(meta, raw, stats=stats)
+        if own:
+            self._record_restore(
+                "storage", step, time.perf_counter() - t0,
+                stats.to_phases(),
+            )
+        logger.info(
+            "restored step %s from storage (read %.3fs, assemble "
+            "%.3fs, %d workers)",
+            step, stats.read_s, stats.assemble_s, stats.workers,
+        )
+        return step, state
+
+    def _load_sparse_from_storage(
+        self, step, shards, want_rank, stats, t0, own,
+    ):
+        """Storage restore with kv state: same-world = own shard
+        verbatim; cross-world = dense from the lowest surviving rank
+        + the hash-resharded kv subset."""
+        any_meta = shards[min(shards)][0]
+        ckpt_world = self._checkpoint_world(any_meta) or len(shards)
+        if ckpt_world == self._world_size and want_rank not in shards:
+            # the world did NOT change — a missing own shard is a
+            # broken checkpoint (partial commit, lost file), not a
+            # reshard: falling through would silently hand this rank
+            # another rank's DENSE state
+            logger.error(
+                "checkpoint step %s has no shard for rank %s though "
+                "the world size (%s) is unchanged; treating the "
+                "checkpoint as unusable", step, want_rank, ckpt_world,
+            )
+            return None, {}
+        same_world = (
+            ckpt_world == self._world_size and want_rank in shards
+        )
+        if same_world:
+            meta, raw = shards[want_rank]
+            state = state_dict_from_raw(meta, raw, stats=stats)
+            state = self._consume_sparse(
+                state, stats, tier="storage", step=step
+            )
+        else:
+            logger.warning(
+                "checkpoint step %s is from world %s, this world is "
+                "%s: resharding kv state from %d rank file(s)",
+                step, ckpt_world, self._world_size, len(shards),
+            )
+            dense_rank = (
+                want_rank if want_rank in shards else min(shards)
+            )
+            kv_per_rank = {}
+            state = {}
+            for rank, (meta, raw) in sorted(shards.items()):
+                rank_state = state_dict_from_raw(
+                    meta, raw, stats=stats
+                )
+                kv_state = (
+                    rank_state.pop(KV_STATE_KEY, None)
+                    if isinstance(rank_state, dict) else None
+                )
+                if kv_state is not None:
+                    kv_per_rank[rank] = kv_state
+                if rank == dense_rank:
+                    state = rank_state
+            if kv_per_rank:
+                info = self._sparse.import_shards(
+                    kv_per_rank,
+                    world_size=self._world_size,
+                    rank=self._rank,
+                    from_world=ckpt_world,
+                    tier="storage",
+                    step=step,
+                )
+                stats.extra.update(info)
         if own:
             self._record_restore(
                 "storage", step, time.perf_counter() - t0,
@@ -585,10 +791,25 @@ class CheckpointEngine:
                 )
                 config, flat = None, {}
             if config is not None and flat:
+                kv_flat = (
+                    self._split_kv_flat(flat)
+                    if self._sparse is not None else {}
+                )
                 state = self._assemble_to_target(
                     target_state, flat, metas, stats
                 )
                 if state is not None:
+                    if kv_flat:
+                        from dlrover_tpu.checkpoint.sparse import (
+                            SparseStateAdapter,
+                        )
+
+                        info = self._sparse.import_state(
+                            SparseStateAdapter.nest_flat(kv_flat),
+                            tier="shm", step=config.step,
+                            rank=self._rank,
+                        )
+                        stats.extra.update(info)
                     self._record_restore(
                         "shm", config.step,
                         time.perf_counter() - t0, stats.to_phases(), sp,
@@ -608,10 +829,19 @@ class CheckpointEngine:
             if step is not None and shards:
                 flat_all: Dict[str, Any] = {}
                 metas_all: Dict[str, Any] = {}
+                kv_per_rank: Dict[int, Dict[str, Any]] = {}
                 for rank, (meta, raw) in sorted(shards.items()):
                     f, m = flat_from_raw(
                         meta, raw, detach=False, stats=stats
                     )
+                    if self._sparse is not None:
+                        # kv keys carry no shard suffix, so across
+                        # ranks they would collide in flat_all (last
+                        # rank silently winning) — each rank's rows
+                        # are DISTINCT table shards, not replicas
+                        kv_f = self._split_kv_flat(f)
+                        if kv_f:
+                            kv_per_rank[rank] = kv_f
                     for key, val in f.items():
                         # shard keys collide across ranks; namespace them
                         nk = (
@@ -624,6 +854,10 @@ class CheckpointEngine:
                     target_state, flat_all, metas_all, stats
                 )
                 if state is not None:
+                    if kv_per_rank:
+                        self._import_sharded_kv(
+                            kv_per_rank, shards, step, stats
+                        )
                     self._record_restore(
                         "storage", step,
                         time.perf_counter() - t0, stats.to_phases(), sp,
@@ -655,6 +889,44 @@ class CheckpointEngine:
                 return step, state
             sp.set_attribute("tier", "none")
             return None, {}
+
+    @staticmethod
+    def _split_kv_flat(flat: Dict[str, Any]) -> Dict[str, Any]:
+        """Pop the ``__kv__/``-prefixed entries out of a flat dict,
+        returned keyed relative to the prefix."""
+        from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+
+        kv, rest = SparseStateAdapter.split_flat(flat)
+        if kv:
+            flat.clear()
+            flat.update(rest)
+        return kv
+
+    def _import_sharded_kv(self, kv_per_rank, shards, step, stats):
+        """kv import for the load_sharded storage tier: own shard
+        verbatim when the world is unchanged and this rank's file
+        exists, the hash-reshard otherwise."""
+        from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+
+        nested = {
+            rank: SparseStateAdapter.nest_flat(kv)
+            for rank, kv in kv_per_rank.items()
+        }
+        ckpt_world = (
+            self._checkpoint_world(shards[min(shards)][0])
+            or len(shards)
+        )
+        if ckpt_world == self._world_size and self._rank in nested:
+            info = self._sparse.import_state(
+                nested[self._rank], tier="storage", step=step,
+                rank=self._rank,
+            )
+        else:
+            info = self._sparse.import_shards(
+                nested, world_size=self._world_size, rank=self._rank,
+                from_world=ckpt_world, tier="storage", step=step,
+            )
+        stats.extra.update(info)
 
     def _assemble_to_target(self, target_state, flat, metas, stats=None):
         """Assemble every leaf of ``target_state`` from saved entries;

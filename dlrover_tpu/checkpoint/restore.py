@@ -97,15 +97,21 @@ class RestoreStats:
     h2d_s: float = 0.0
     bytes: int = 0
     workers: int = field(default_factory=restore_workers)
+    # tier-specific extras surfaced on the restore event/phase dict —
+    # the sparse (KvVariable) import records kv_s/kv_rows here so the
+    # timeline's restore slices show the kv stage
+    extra: Dict[str, Any] = field(default_factory=dict)
 
     def to_phases(self) -> Dict[str, Any]:
-        return {
+        phases = {
             "read_s": round(self.read_s, 4),
             "assemble_s": round(self.assemble_s, 4),
             "h2d_s": round(self.h2d_s, 4),
             "bytes": int(self.bytes),
             "workers": int(self.workers),
         }
+        phases.update(self.extra)
+        return phases
 
 
 class _InlineFuture:

@@ -1,0 +1,474 @@
+"""KvVariable state <-> flash checkpoint: the sparse adapter.
+
+Reference: TFPlus persists hash-table embedding state through its
+checkpoint system (``tfplus/kv_variable/python/training/
+checkpoint_manager.py:34`` — KvVariable export ops feeding TF
+checkpoints).  DLRover's whole sparse-elasticity story assumes
+embedding rows, frequency counters and optimizer slots survive
+scaling; this module is the TPU repo's version of that contract.
+
+A :class:`SparseStateAdapter` registers host-resident
+:class:`~dlrover_tpu.ops.kv_variable.KvVariable` tables (the
+embedding table AND its optimizer's slot tables) with the
+flash-checkpoint engine.  On every save the engine asks the adapter
+for an :meth:`export_state` snapshot — plain numpy ``keys`` /
+``values`` / ``freq`` blobs, nested under the reserved ``__kv__``
+pytree key — which rides the shm segment next to the dense state and
+is persisted to committed storage per rank by the unchanged agent
+saver.  On restore the engine hands the blobs back and the adapter
+``import_``\\ s them.
+
+Cross-world semantics (the elastic-resize contract): the shm tier is
+per-node state and is REFUSED across a world change (the dense rule);
+cross-world restores read every old rank's kv shard from committed
+storage and RESHARD the hash table — rows are re-partitioned by
+:func:`owner_of_keys` (the same splitmix64 finalizer the C++ store
+hashes with) onto the new world, and each rank imports exactly its
+owned subset.  Jobs that want cross-world sparse restores must
+partition training traffic with the same owner function (the
+DeepFM/sparse chaos scripts do); same-world restores import each
+rank's own shard verbatim, with no ownership assumption.
+
+Telemetry: every export/import emits a ``kv_checkpoint`` event
+(rows, bytes, spilled rows, tier, reshard accounting) and records
+``dlrover_kv_checkpoint_seconds{stage}``.  With ``DLROVER_KV_DIGEST``
+set, events additionally carry an order-independent per-table content
+digest (sum mod 2**64 of per-row hashes over key+values+freq) — the
+chaos invariants prove "every row, frequency count and optimizer
+slot bit-identical through the cycle" from the event log alone, and
+the digests are additive across disjoint shards, so exactly-once
+resharding is checkable as sum-of-new-digests == sum-of-old-digests.
+"""
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu import chaos as _chaos
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+
+# reserved top-level pytree key the adapter's blobs ride under; the
+# engine strips it before handing the dense state back to the caller
+KV_STATE_KEY = "__kv__"
+KV_PREFIX = KV_STATE_KEY + "/"
+# nested key holding non-table optimizer state (step counters)
+SCALARS_KEY = "__scalars__"
+
+_REG = get_registry()
+_KV_CKPT_SECONDS = _REG.histogram(
+    "dlrover_kv_checkpoint_seconds",
+    "Sparse (KvVariable) checkpoint stage time "
+    "(labels: stage = export / import / reshard)",
+)
+
+
+def _hash64(keys: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64/murmur finalizer — bit-identical to
+    ``Table::hash_key`` in ``native/kv_store.cc``, so the Python-side
+    ownership partition and the C++ table agree on key placement."""
+    x = np.ascontiguousarray(keys, dtype=np.int64).view(np.uint64).copy()
+    x ^= x >> np.uint64(33)
+    x *= np.uint64(0xFF51AFD7ED558CCD)
+    x ^= x >> np.uint64(33)
+    x *= np.uint64(0xC4CEB9FE1A85EC53)
+    x ^= x >> np.uint64(33)
+    return x
+
+
+def owner_of_keys(keys: np.ndarray, world_size: int) -> np.ndarray:
+    """Rank that owns each key in a ``world_size`` world.  THE
+    partition rule of cross-world sparse restores: reshard assigns
+    every row to ``hash64(key) % world_size``, and sparse train loops
+    that want elastic resizes route each key's traffic the same way."""
+    if world_size <= 1:
+        return np.zeros(np.asarray(keys).size, dtype=np.int64)
+    return (_hash64(keys) % np.uint64(world_size)).astype(np.int64)
+
+
+_FNV_PRIME = np.uint64(0x100000001B3)
+
+
+def rows_digest(
+    keys: np.ndarray, values: np.ndarray, freq: np.ndarray
+) -> int:
+    """Order-independent content digest of a row set: per-row FNV-ish
+    hash over key + value bytes + frequency, summed mod 2**64.
+
+    Two properties the chaos invariants lean on: (a) row ORDER never
+    matters (export order changes across an import), (b) digests of
+    DISJOINT shards add — the union's digest is the wrapped sum of
+    the shard digests, so exactly-once resharding is provable from
+    per-rank events alone (a lost row changes the sum; a duplicated
+    row adds its hash twice)."""
+    n = int(np.asarray(keys).size)
+    if n == 0:
+        return 0
+    h = _hash64(keys)
+    vb = np.ascontiguousarray(values, dtype=np.float32).reshape(n, -1)
+    raw = vb.view(np.uint8).reshape(n, -1)
+    pad = (-raw.shape[1]) % 8
+    if pad:
+        raw = np.concatenate(
+            [raw, np.zeros((n, pad), dtype=np.uint8)], axis=1
+        )
+    cols = raw.view(np.uint64)
+    with np.errstate(over="ignore"):
+        for j in range(cols.shape[1]):
+            h = (h ^ cols[:, j]) * _FNV_PRIME
+        h = (h ^ np.ascontiguousarray(freq, dtype=np.uint64)) * _FNV_PRIME
+        total = np.sum(h, dtype=np.uint64)
+    return int(total)
+
+
+def _digest_enabled() -> bool:
+    return os.environ.get(
+        "DLROVER_KV_DIGEST", ""
+    ).strip().lower() in ("1", "true", "yes", "on")
+
+
+def _enc(name: str) -> str:
+    """Table names may contain '/' (slot tables are named
+    '<table>/m'); the pytree path separator is also '/'.  Encode to
+    keep one nesting level per table so shard extraction and event
+    digests stay keyed by whole table."""
+    return name.replace("/", ".")
+
+
+class SparseStateAdapter:
+    """Registers KvVariable tables + sparse optimizers with the flash
+    checkpoint engine (``engine.register_sparse(adapter)`` /
+    ``Checkpointer.register_sparse``).
+
+    ``digest=None`` reads ``DLROVER_KV_DIGEST`` (the chaos scenarios
+    arm it); digests cost one vectorized pass over the exported rows.
+    """
+
+    def __init__(self, digest: Optional[bool] = None):
+        self._tables: Dict[str, Any] = {}
+        self._optimizers: List[Any] = []
+        self._digest = digest
+
+    # -- registration -------------------------------------------------------
+
+    def register_table(self, table) -> "SparseStateAdapter":
+        name = _enc(table.name)
+        if name in self._tables and self._tables[name] is not table:
+            raise ValueError(
+                f"a different table is already registered as {name!r}"
+                " — table names must be unique per adapter"
+            )
+        self._tables[name] = table
+        return self
+
+    def register_optimizer(self, optimizer) -> "SparseStateAdapter":
+        """Register a sparse optimizer: its parameter table, every
+        slot table (GroupAdam m/v, Adagrad acc, FTRL z/n, ...) and
+        its step-counter scalars all become checkpoint state."""
+        self.register_table(optimizer.table)
+        for slot in optimizer.slot_tables().values():
+            self.register_table(slot)
+        if optimizer not in self._optimizers:
+            self._optimizers.append(optimizer)
+        return self
+
+    @property
+    def tables(self) -> Dict[str, Any]:
+        return dict(self._tables)
+
+    def digest_enabled(self) -> bool:
+        return self._digest if self._digest is not None else (
+            _digest_enabled()
+        )
+
+    # -- export (save path) -------------------------------------------------
+
+    def export_state(
+        self, step: Optional[int] = None, rank: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Snapshot every registered table into plain numpy blobs
+        (spilled rows included — ``KvVariable.export`` covers both
+        tiers) plus optimizer scalars.  The returned dict nests under
+        :data:`KV_STATE_KEY` in the engine's state dict and rides the
+        shm segment like any other array leaves, so the save stall
+        grows only by these memcpys (the table is host RAM already;
+        there is no device fetch).
+
+        Chaos hook ``kv.spill``: an injected ``io_error`` here plays
+        a spill-tier disk dying DURING the export — the adapter
+        breaks every registered table's cold tier (subsequent spill
+        IO fails like a dead device) and proceeds: stranded cold rows
+        drop out of the export, DRAM rows persist, and the production
+        write-failure breaker trips on the next training step."""
+        try:
+            _chaos.fire("kv.spill", step=step)
+        except OSError:
+            logger.error(
+                "kv.spill io_error injected: breaking the spill tier "
+                "of %d table(s) mid-export", len(self._tables),
+            )
+            for table in self._tables.values():
+                table._break_spill_tier()
+        t0 = time.perf_counter()
+        with_digest = self.digest_enabled()
+        out: Dict[str, Any] = {}
+        digests: Dict[str, Dict[str, Any]] = {}
+        rows = nbytes = spilled = lost = 0
+        spill_disabled = False
+        for name, table in self._tables.items():
+            logical = len(table)
+            keys, values, freq = table.export()
+            out[name] = {"keys": keys, "values": values, "freq": freq}
+            rows += len(keys)
+            lost += max(0, logical - len(keys))
+            nbytes += keys.nbytes + values.nbytes + freq.nbytes
+            st = table.spill_stats()
+            spilled += st["disk_rows"]
+            spill_disabled = spill_disabled or st["disabled"]
+            if with_digest:
+                digests[name] = {
+                    "rows": int(len(keys)),
+                    "sum": f"{rows_digest(keys, values, freq):016x}",
+                }
+        scalars = {
+            _enc(opt.table.name): opt.state_scalars()
+            for opt in self._optimizers
+            if hasattr(opt, "state_scalars")
+        }
+        if scalars:
+            out[SCALARS_KEY] = scalars
+        seconds = time.perf_counter() - t0
+        _KV_CKPT_SECONDS.observe(seconds, stage="export")
+        event = dict(
+            stage="export", rows=int(rows), bytes=int(nbytes),
+            spilled_rows=int(spilled), seconds=round(seconds, 4),
+            tables=len(self._tables),
+        )
+        if step is not None:
+            event["step"] = int(step)
+        if rank is not None:
+            event["rank"] = int(rank)
+        if spill_disabled:
+            event["spill_disabled"] = True
+        if lost:
+            # rows the logical table claims but the export could not
+            # read (a dead spill tier) — the checkpoint is still
+            # valid for everything it DOES contain
+            event["lost_rows"] = int(lost)
+        if digests:
+            event["digests"] = digests
+        emit_event("kv_checkpoint", **event)
+        return out
+
+    # -- import (restore path) ----------------------------------------------
+
+    def _import_tables(
+        self, per_table: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]],
+        scalars: Optional[Dict] = None,
+    ) -> Tuple[int, int, Dict[str, Dict[str, Any]]]:
+        """Replace every registered table's contents; returns
+        (rows, bytes, digests)."""
+        with_digest = self.digest_enabled()
+        rows = nbytes = 0
+        digests: Dict[str, Dict[str, Any]] = {}
+        for name, table in self._tables.items():
+            blob = per_table.get(name)
+            if blob is None:
+                logger.warning(
+                    "checkpoint has no kv state for table %r; leaving "
+                    "it untouched", name,
+                )
+                continue
+            keys, values, freq = blob
+            table.clear()
+            table.import_(keys, values, freq)
+            rows += len(keys)
+            nbytes += keys.nbytes + values.nbytes + freq.nbytes
+            if with_digest:
+                digests[name] = {
+                    "rows": int(len(keys)),
+                    "sum": f"{rows_digest(keys, values, freq):016x}",
+                }
+        if scalars:
+            for opt in self._optimizers:
+                sc = scalars.get(_enc(opt.table.name))
+                if sc and hasattr(opt, "load_state_scalars"):
+                    opt.load_state_scalars(sc)
+        return rows, nbytes, digests
+
+    @staticmethod
+    def _blobs_from(state: Dict) -> Tuple[Dict, Optional[Dict]]:
+        """Nested kv state dict -> ({table: (keys, values, freq)},
+        scalars)."""
+        per_table = {}
+        for name, sub in state.items():
+            if name == SCALARS_KEY or not isinstance(sub, dict):
+                continue
+            if "keys" not in sub:
+                continue
+            per_table[name] = (
+                np.ascontiguousarray(sub["keys"], dtype=np.int64),
+                np.ascontiguousarray(sub["values"], dtype=np.float32),
+                np.ascontiguousarray(sub["freq"], dtype=np.uint64),
+            )
+        return per_table, state.get(SCALARS_KEY)
+
+    def import_state(
+        self, state: Dict, tier: str = "", step: Optional[int] = None,
+        rank: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Same-world restore: import one rank's own kv shard
+        verbatim (no ownership assumption).  Returns the info dict
+        the engine folds into the restore phase breakdown."""
+        t0 = time.perf_counter()
+        per_table, scalars = self._blobs_from(state)
+        rows, nbytes, digests = self._import_tables(per_table, scalars)
+        seconds = time.perf_counter() - t0
+        _KV_CKPT_SECONDS.observe(seconds, stage="import")
+        event = dict(
+            stage="restore", rows=int(rows), bytes=int(nbytes),
+            seconds=round(seconds, 4), tables=len(per_table),
+            resharded=False,
+        )
+        if tier:
+            event["tier"] = tier
+        if step is not None:
+            event["step"] = int(step)
+        if rank is not None:
+            event["rank"] = int(rank)
+        if digests:
+            event["digests"] = digests
+        emit_event("kv_checkpoint", **event)
+        return {"kv_s": round(seconds, 4), "kv_rows": int(rows)}
+
+    def import_shards(
+        self,
+        shards: Dict[int, Dict],
+        world_size: int,
+        rank: int,
+        from_world: Optional[int] = None,
+        tier: str = "storage",
+        step: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Cross-world restore: RESHARD the hash table from every old
+        rank's kv state.  Rows are concatenated across shards
+        (deduped by key, later rank wins — a well-partitioned job
+        never collides), re-partitioned by :func:`owner_of_keys` onto
+        the new ``world_size``, and exactly this rank's owned subset
+        replaces the table contents.  Optimizer scalars come from the
+        lowest old rank.  ``shards`` maps old global rank -> nested
+        kv state dict."""
+        t0 = time.perf_counter()
+        if from_world is None:
+            from_world = len(shards)
+        per_rank = {
+            r: self._blobs_from(state) for r, state in sorted(
+                shards.items()
+            )
+        }
+        owned: Dict[str, Tuple] = {}
+        total_rows = 0
+        for name in self._tables:
+            ks, vs, fs = [], [], []
+            for r, (per_table, _) in per_rank.items():
+                blob = per_table.get(name)
+                if blob is not None:
+                    ks.append(blob[0])
+                    vs.append(blob[1])
+                    fs.append(blob[2])
+            if not ks:
+                continue
+            keys = np.concatenate(ks)
+            dim = self._tables[name].dim
+            values = np.concatenate(
+                [v.reshape(-1, dim) for v in vs]
+            )
+            freq = np.concatenate(fs)
+            # dedupe by key, keeping the LAST occurrence (highest old
+            # rank) — mirrors import_'s overwrite semantics
+            _, last_idx = np.unique(keys[::-1], return_index=True)
+            keep = np.sort(len(keys) - 1 - last_idx)
+            keys, values, freq = keys[keep], values[keep], freq[keep]
+            total_rows += len(keys)
+            mine = owner_of_keys(keys, world_size) == rank
+            owned[name] = (keys[mine], values[mine], freq[mine])
+        for name, table in self._tables.items():
+            if name not in owned:
+                # a registered table with no rows in ANY old shard
+                # must still be CLEARED: a reshard-in-place that left
+                # it untouched would keep the previous world's rows —
+                # phantom duplicates of rows the key-hash partition
+                # assigned to other ranks
+                owned[name] = (
+                    np.empty(0, np.int64),
+                    np.empty((0, table.dim), np.float32),
+                    np.empty(0, np.uint64),
+                )
+        scalars = None
+        for _r, (_pt, sc) in per_rank.items():
+            if sc:
+                scalars = sc
+                break
+        rows, nbytes, digests = self._import_tables(owned, scalars)
+        seconds = time.perf_counter() - t0
+        _KV_CKPT_SECONDS.observe(seconds, stage="reshard")
+        event = dict(
+            stage="restore", rows=int(rows), bytes=int(nbytes),
+            seconds=round(seconds, 4), tables=len(owned),
+            resharded=True, from_world=int(from_world),
+            world_size=int(world_size), total_rows=int(total_rows),
+            tier=tier,
+        )
+        if step is not None:
+            event["step"] = int(step)
+        event["rank"] = int(rank)
+        if digests:
+            event["digests"] = digests
+        emit_event("kv_checkpoint", **event)
+        logger.info(
+            "resharded kv restore: %d/%d row(s) owned by rank %d of "
+            "world %d (from world %s, %d table(s), %.3fs)",
+            rows, total_rows, rank, world_size, from_world,
+            len(owned), seconds,
+        )
+        return {
+            "kv_s": round(seconds, 4),
+            "kv_rows": int(rows),
+            "kv_resharded": True,
+        }
+
+    # -- flat-key helpers (engine's load_sharded path) ----------------------
+
+    @staticmethod
+    def split_flat(flat: Dict[str, Any]) -> Tuple[Dict, Dict]:
+        """Partition a flat {path: leaf} dict into (kv entries keyed
+        RELATIVE to the ``__kv__/`` prefix, the rest)."""
+        kv: Dict[str, Any] = {}
+        rest: Dict[str, Any] = {}
+        for key, val in flat.items():
+            if key.startswith(KV_PREFIX):
+                kv[key[len(KV_PREFIX):]] = val
+            elif key == KV_STATE_KEY:
+                # the whole subtree survived as one pickled scalar
+                # (nothing array-valued): unwrap it
+                if isinstance(val, dict):
+                    for k2, v2 in val.items():
+                        kv[k2] = v2
+            else:
+                rest[key] = val
+        return kv, rest
+
+    @staticmethod
+    def nest_flat(flat: Dict[str, Any]) -> Dict[str, Any]:
+        """{"emb/keys": arr, "__scalars__/emb/step": 3} -> nested."""
+        root: Dict[str, Any] = {}
+        for key, value in flat.items():
+            parts = key.split("/")
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = value
+        return root

@@ -381,6 +381,49 @@ void kv_spill_stats(void* handle, long* out) {
 
 int kv_dim(void* handle) { return static_cast<Table*>(handle)->dim; }
 
+// Drop every row on BOTH tiers (checkpoint import replaces, never
+// merges: a resharded restore must import exactly the owned subset,
+// and rows left over from a previous world would be phantom
+// duplicates the key-hash partition already assigned elsewhere).
+// Spill-tier failure accounting is preserved — a tripped breaker
+// stays tripped across an import (the disk did not heal because the
+// table was reloaded).
+void kv_clear(void* handle) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  t->row_keys.clear();
+  t->values.clear();
+  t->freq.clear();
+  t->used = 0;
+  std::fill(t->keys.begin(), t->keys.end(), kEmptyKey);
+  std::fill(t->rows.begin(), t->rows.end(), -1);
+  if (t->spill) {
+    t->spill->index.clear();
+    t->spill->free_slots.clear();
+    t->spill->next_slot = 0;
+  }
+}
+
+// Chaos/test hook: make the spill tier's backing device fail like a
+// dead disk — every subsequent pwrite fails (EBADF), every pread
+// comes back short.  The write-failure breaker then trips through
+// its production path, export skips the stranded records, and DRAM
+// rows are untouched.  Re-arming requires kv_spill_enable (which
+// reopens nothing here — the fd stays dead until the table is
+// rebuilt), exactly like a disk that is not coming back.
+void kv_spill_break(void* handle) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  if (!t->spill || t->spill->fd < 0) return;
+  ::close(t->spill->fd);
+  // /dev/null opened read-only: pwrite -> EBADF, pread -> 0 bytes
+  // (short read); keeps the fd slot valid for the destructor.
+  t->spill->fd = ::open("/dev/null", O_RDONLY);
+  std::fprintf(stderr,
+               "kv_store: spill tier on %s broken by fault injection\n",
+               t->spill->path.c_str());
+}
+
 // Gather rows for keys; missing keys are inserted (random or zero
 // init) when insert_missing, else zero-filled in the output.
 // Reference ops: KvVariableGatherOrInsert / GatherOrZeros.
@@ -689,6 +732,126 @@ void kv_apply_group_ftrl(void* param_h, void* z_h, void* n_h,
   p->maybe_spill_cold();
   zt->maybe_spill_cold();
   nt->maybe_spill_cold();
+}
+
+// Plain sparse SGD over the touched keys (reference: tfplus
+// training/gradient_descent.py — the sparse path of
+// GradientDescentOptimizer; no slot tables).
+void kv_apply_sparse_sgd(void* param_h, const int64_t* keys,
+                         const float* grads, long n, float lr) {
+  Table* p = static_cast<Table*>(param_h);
+  std::lock_guard<std::mutex> lp(p->mu);
+  const int dim = p->dim;
+  for (long i = 0; i < n; ++i) {
+    int64_t prow = p->find_or_promote(keys[i]);
+    if (prow < 0) prow = p->insert(keys[i], nullptr, true);
+    float* w = p->row_ptr(prow);
+    const float* g = grads + i * dim;
+    p->freq[prow] += 1;
+    for (int d = 0; d < dim; ++d) w[d] -= lr * g[d];
+  }
+  p->maybe_spill_cold();
+}
+
+// Plain sparse Adam (reference: tfplus training/adam.py — standard
+// Adam whose bias correction rides the learning rate:
+// lr_t = lr * sqrt(1 - beta2^t) / (1 - beta1^t)), vs the group
+// flavour above which corrects the moments per-dimension and adds
+// decoupled weight decay.
+void kv_apply_sparse_adam(void* param_h, void* m_h, void* v_h,
+                          const int64_t* keys, const float* grads,
+                          long n, float lr, float beta1, float beta2,
+                          float eps, long step) {
+  Table* p = static_cast<Table*>(param_h);
+  Table* m = static_cast<Table*>(m_h);
+  Table* v = static_cast<Table*>(v_h);
+  std::lock_guard<std::mutex> lp(p->mu);
+  std::lock_guard<std::mutex> lm(m->mu);
+  std::lock_guard<std::mutex> lv(v->mu);
+  const int dim = p->dim;
+  const float t = static_cast<float>(step);
+  const float lr_t = lr * std::sqrt(1.0f - std::pow(beta2, t)) /
+                     (1.0f - std::pow(beta1, t));
+  for (long i = 0; i < n; ++i) {
+    int64_t prow = p->find_or_promote(keys[i]);
+    if (prow < 0) prow = p->insert(keys[i], nullptr, true);
+    int64_t mrow = m->find_or_promote(keys[i]);
+    if (mrow < 0) mrow = m->insert(keys[i], nullptr, false);
+    int64_t vrow = v->find_or_promote(keys[i]);
+    if (vrow < 0) vrow = v->insert(keys[i], nullptr, false);
+    float* w = p->row_ptr(prow);
+    float* mu = m->row_ptr(mrow);
+    float* nu = v->row_ptr(vrow);
+    const float* g = grads + i * dim;
+    p->freq[prow] += 1;
+    for (int d = 0; d < dim; ++d) {
+      mu[d] = beta1 * mu[d] + (1.0f - beta1) * g[d];
+      nu[d] = beta2 * nu[d] + (1.0f - beta2) * g[d] * g[d];
+      w[d] -= lr_t * mu[d] / (std::sqrt(nu[d]) + eps);
+    }
+  }
+  p->maybe_spill_cold();
+  m->maybe_spill_cold();
+  v->maybe_spill_cold();
+}
+
+// Rectified Adam (reference: tfplus training/rectified_adam.py /
+// Liu et al. 2019): the adaptive term is used only once the variance
+// estimate's rectification r_t is defined (rho_t > 4); earlier steps
+// fall back to bias-corrected momentum SGD.  Warm-up without a
+// schedule — exactly the cold-start regime a freshly inserted
+// embedding row lives in.
+void kv_apply_rectified_adam(void* param_h, void* m_h, void* v_h,
+                             const int64_t* keys, const float* grads,
+                             long n, float lr, float beta1, float beta2,
+                             float eps, float weight_decay, long step) {
+  Table* p = static_cast<Table*>(param_h);
+  Table* m = static_cast<Table*>(m_h);
+  Table* v = static_cast<Table*>(v_h);
+  std::lock_guard<std::mutex> lp(p->mu);
+  std::lock_guard<std::mutex> lm(m->mu);
+  std::lock_guard<std::mutex> lv(v->mu);
+  const int dim = p->dim;
+  const float t = static_cast<float>(step);
+  const float beta2_t = std::pow(beta2, t);
+  const float bc1 = 1.0f - std::pow(beta1, t);
+  const float bc2 = 1.0f - beta2_t;
+  const float rho_inf = 2.0f / (1.0f - beta2) - 1.0f;
+  const float rho_t = rho_inf - 2.0f * t * beta2_t / bc2;
+  float r_t = 0.0f;
+  const bool rectified = rho_t > 4.0f;
+  if (rectified) {
+    r_t = std::sqrt(((rho_t - 4.0f) * (rho_t - 2.0f) * rho_inf) /
+                    ((rho_inf - 4.0f) * (rho_inf - 2.0f) * rho_t));
+  }
+  for (long i = 0; i < n; ++i) {
+    int64_t prow = p->find_or_promote(keys[i]);
+    if (prow < 0) prow = p->insert(keys[i], nullptr, true);
+    int64_t mrow = m->find_or_promote(keys[i]);
+    if (mrow < 0) mrow = m->insert(keys[i], nullptr, false);
+    int64_t vrow = v->find_or_promote(keys[i]);
+    if (vrow < 0) vrow = v->insert(keys[i], nullptr, false);
+    float* w = p->row_ptr(prow);
+    float* mu = m->row_ptr(mrow);
+    float* nu = v->row_ptr(vrow);
+    const float* g = grads + i * dim;
+    p->freq[prow] += 1;
+    for (int d = 0; d < dim; ++d) {
+      float gd = g[d] + weight_decay * w[d];
+      mu[d] = beta1 * mu[d] + (1.0f - beta1) * gd;
+      nu[d] = beta2 * nu[d] + (1.0f - beta2) * gd * gd;
+      float mhat = mu[d] / bc1;
+      if (rectified) {
+        float vhat = std::sqrt(nu[d] / bc2);
+        w[d] -= lr * r_t * mhat / (vhat + eps);
+      } else {
+        w[d] -= lr * mhat;
+      }
+    }
+  }
+  p->maybe_spill_cold();
+  m->maybe_spill_cold();
+  v->maybe_spill_cold();
 }
 
 }  // extern "C"

@@ -104,6 +104,23 @@ def _load():
             i64p, f32p, ctypes.c_long, ctypes.c_float, ctypes.c_float,
             ctypes.c_float, ctypes.c_float,
         ]
+        lib.kv_clear.argtypes = [ctypes.c_void_p]
+        lib.kv_spill_break.argtypes = [ctypes.c_void_p]
+        lib.kv_apply_sparse_sgd.argtypes = [
+            ctypes.c_void_p, i64p, f32p, ctypes.c_long, ctypes.c_float,
+        ]
+        lib.kv_apply_sparse_adam.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            i64p, f32p, ctypes.c_long,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_long,
+        ]
+        lib.kv_apply_rectified_adam.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            i64p, f32p, ctypes.c_long,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_long,
+        ]
         _lib = lib
     return _lib
 
@@ -306,6 +323,23 @@ class KvVariable:
             keys.size,
         )
 
+    def clear(self):
+        """Drop every row on both tiers.  Checkpoint import REPLACES
+        table state (a resharded restore must hold exactly the owned
+        subset — leftover rows from a previous world would be phantom
+        duplicates of rows the key-hash partition assigned to another
+        rank)."""
+        self._lib.kv_clear(self._handle)
+
+    def _break_spill_tier(self):
+        """Fault-injection hook (chaos ``io_error`` on the spill
+        tier): make the cold tier's backing device fail like a dead
+        disk — subsequent spill writes error out (tripping the
+        production write-failure breaker), stranded cold records read
+        back short and are skipped by export.  DRAM rows are
+        untouched."""
+        self._lib.kv_spill_break(self._handle)
+
     # -- JAX bridge --------------------------------------------------------
 
     def jax_gather(self, keys, insert_missing: bool = True):
@@ -384,14 +418,33 @@ class GroupAdamOptimizer:
         """Spill the moment tables alongside the (separately
         configured or not) parameter table — training past DRAM
         needs ALL per-key state bounded, not just the embeddings."""
-        import os as _os
+        _enable_slot_spill(self, directory, max_dram_rows)
 
-        self.m.enable_spill(
-            _os.path.join(directory, f"{self.table.name}_m.spill"),
-            max_dram_rows,
-        )
-        self.v.enable_spill(
-            _os.path.join(directory, f"{self.table.name}_v.spill"),
+    def slot_tables(self):
+        """Optimizer-state tables keyed by slot name — the sparse
+        checkpoint adapter registers them next to the parameter table
+        so a restore brings the moments back bit-exact."""
+        return {"m": self.m, "v": self.v}
+
+    def state_scalars(self):
+        """Non-table optimizer state (the bias-correction step
+        counter) — without it a restored Adam replays with the wrong
+        correction and the loss trajectory forks from the control."""
+        return {"step": int(self.step)}
+
+    def load_state_scalars(self, scalars):
+        self.step = int(scalars.get("step", self.step))
+
+
+def _enable_slot_spill(optimizer, directory: str, max_dram_rows: int):
+    """Shared slot-table spill wiring: every slot spills to its own
+    record file named after the parameter table and the slot."""
+    import os as _os
+
+    base = optimizer.table.name.replace("/", "_")
+    for slot, table in optimizer.slot_tables().items():
+        table.enable_spill(
+            _os.path.join(directory, f"{base}_{slot}.spill"),
             max_dram_rows,
         )
 
@@ -416,6 +469,12 @@ class GroupAdagradOptimizer:
             _f32(grads), keys.size, self.lr, self.init_acc, self.eps,
         )
 
+    def enable_spill(self, directory: str, max_dram_rows: int) -> None:
+        _enable_slot_spill(self, directory, max_dram_rows)
+
+    def slot_tables(self):
+        return {"acc": self.acc}
+
 
 class GroupFtrlOptimizer:
     """Sparse FTRL (reference: tfplus/training/group_ftrl.py)."""
@@ -437,3 +496,117 @@ class GroupFtrlOptimizer:
             _i64(keys), _f32(grads), keys.size, self.lr, self.l1,
             self.l2, -0.5,
         )
+
+    def enable_spill(self, directory: str, max_dram_rows: int) -> None:
+        _enable_slot_spill(self, directory, max_dram_rows)
+
+    def slot_tables(self):
+        return {"z": self.z, "n": self.n}
+
+
+class SparseSGDOptimizer:
+    """Plain sparse SGD (reference: tfplus
+    training/gradient_descent.py) — no slot tables; the cheapest
+    sparse trainer for frequency-skewed tails."""
+
+    def __init__(self, table: KvVariable, learning_rate: float = 0.1):
+        self._lib = _load()
+        self.table = table
+        self.lr = learning_rate
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._lib.kv_apply_sparse_sgd(
+            self.table._handle, _i64(keys), _f32(grads), keys.size,
+            self.lr,
+        )
+
+    def slot_tables(self):
+        return {}
+
+
+class SparseAdamOptimizer:
+    """Plain sparse Adam (reference: tfplus training/adam.py):
+    standard Adam whose bias correction rides the learning rate
+    (``lr_t = lr * sqrt(1-b2^t)/(1-b1^t)``), vs the group flavour's
+    per-dimension moment correction + decoupled weight decay."""
+
+    def __init__(self, table: KvVariable, learning_rate: float = 1e-3,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8):
+        self._lib = _load()
+        self.table = table
+        self.m = KvVariable(table.dim, name=f"{table.name}/m")
+        self.v = KvVariable(table.dim, name=f"{table.name}/v")
+        self.lr = learning_rate
+        self.beta1, self.beta2 = beta1, beta2
+        self.eps = eps
+        self.step = 0
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray):
+        self.step += 1
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._lib.kv_apply_sparse_adam(
+            self.table._handle, self.m._handle, self.v._handle,
+            _i64(keys), _f32(grads), keys.size,
+            self.lr, self.beta1, self.beta2, self.eps, self.step,
+        )
+
+    def enable_spill(self, directory: str, max_dram_rows: int) -> None:
+        _enable_slot_spill(self, directory, max_dram_rows)
+
+    def slot_tables(self):
+        return {"m": self.m, "v": self.v}
+
+    def state_scalars(self):
+        return {"step": int(self.step)}
+
+    def load_state_scalars(self, scalars):
+        self.step = int(scalars.get("step", self.step))
+
+
+class RectifiedAdamOptimizer:
+    """Sparse RAdam (reference: tfplus training/rectified_adam.py /
+    Liu et al. 2019): the adaptive term engages only once the
+    variance rectification ``r_t`` is defined (``rho_t > 4``); early
+    steps fall back to bias-corrected momentum SGD — warm-up without
+    a schedule, exactly the regime a freshly inserted embedding row
+    lives in."""
+
+    def __init__(self, table: KvVariable, learning_rate: float = 1e-3,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8, weight_decay: float = 0.0):
+        self._lib = _load()
+        self.table = table
+        self.m = KvVariable(table.dim, name=f"{table.name}/m")
+        self.v = KvVariable(table.dim, name=f"{table.name}/v")
+        self.lr = learning_rate
+        self.beta1, self.beta2 = beta1, beta2
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step = 0
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray):
+        self.step += 1
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._lib.kv_apply_rectified_adam(
+            self.table._handle, self.m._handle, self.v._handle,
+            _i64(keys), _f32(grads), keys.size,
+            self.lr, self.beta1, self.beta2, self.eps,
+            self.weight_decay, self.step,
+        )
+
+    def enable_spill(self, directory: str, max_dram_rows: int) -> None:
+        _enable_slot_spill(self, directory, max_dram_rows)
+
+    def slot_tables(self):
+        return {"m": self.m, "v": self.v}
+
+    def state_scalars(self):
+        return {"step": int(self.step)}
+
+    def load_state_scalars(self, scalars):
+        self.step = int(scalars.get("step", self.step))

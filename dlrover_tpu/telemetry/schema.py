@@ -104,6 +104,19 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
            allow_extra=True),
         _s("checkpoint_persist", ["step", "ok", "seconds"]),
         _s("checkpoint_commit", ["step"]),
+        # sparse (KvVariable) state riding the flash checkpoint:
+        # stage=export on every save, stage=restore on every import;
+        # resharded restores carry exactly-once accounting
+        # (rows = imported subset, total_rows = distinct union across
+        # the old world) and per-table content digests when
+        # DLROVER_KV_DIGEST is armed (order-independent, additive
+        # across disjoint shards — the chaos invariants' raw material)
+        _s("kv_checkpoint",
+           ["stage", "rows", "bytes"],
+           ["step", "rank", "tier", "seconds", "tables",
+            "spilled_rows", "spill_disabled", "lost_rows",
+            "resharded", "from_world", "world_size", "total_rows",
+            "digests"]),
         # -- agent ---------------------------------------------------
         # reason: failure / membership / hang / resize — what drove
         # this restart (resize restarts are planned drains)

@@ -194,13 +194,22 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
             continue
         if etype == "checkpoint_restore":
             total = _num(e.get("total_s"))
+            # sparse restores carry a kv stage (KvVariable import /
+            # cross-world reshard) — surface it on the slice so a
+            # sparse job's recovery breakdown shows where the hash
+            # table went back in
+            kv_rows = e.get("kv_rows")
+            name = f"restore[{e.get('tier')}] step {e.get('step')}"
+            if kv_rows:
+                name += " +kv"
             tl.slices.append(Slice(
-                name=f"restore[{e.get('tier')}] step {e.get('step')}",
+                name=name,
                 cat=CAUSE_RESTORE,
                 start=ts - total, end=ts, track=track,
                 meta={k: e.get(k) for k in (
                     "tier", "step", "read_s", "assemble_s", "h2d_s",
-                )},
+                    "kv_s", "kv_rows", "kv_resharded",
+                ) if e.get(k) is not None},
             ))
             continue
         if etype == "checkpoint_shm_save":

@@ -129,16 +129,47 @@ class SparseTrainPipeline:
         )
         self.stats["update_s"] += time.perf_counter() - t1
 
+    def attach_checkpoint(self, checkpointer):
+        """Wire this pipeline's sparse state into a
+        :class:`~dlrover_tpu.checkpoint.checkpointer.Checkpointer`:
+        builds a :class:`~dlrover_tpu.checkpoint.sparse.
+        SparseStateAdapter` over the embedding table + the
+        optimizer's slot tables (and step counter) and registers it
+        with the flash-checkpoint engine, so every ``save_checkpoint``
+        snapshots the hash tables alongside the dense state and every
+        restore imports them back.  Returns the adapter.
+
+        Checkpoint-consistent snapshots need the table quiescent at
+        the save call: run the pipeline in ``strict`` mode when
+        saving mid-run (the ``on_step`` callback fires with no update
+        in flight), or save between :meth:`run` calls in pipelined
+        mode (the trailing update is drained at return)."""
+        from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+
+        adapter = SparseStateAdapter()
+        if hasattr(self.sparse_optimizer, "slot_tables"):
+            adapter.register_optimizer(self.sparse_optimizer)
+        else:
+            adapter.register_table(self.table)
+        checkpointer.register_sparse(adapter)
+        return adapter
+
     def run(
         self,
         state,
         batches: Iterable[Tuple[np.ndarray, ...]],
         on_aux: Optional[Callable[[Any], None]] = None,
+        on_step: Optional[Callable[[Any, int], None]] = None,
     ):
         """Consume ``batches`` of ``(sparse_ids, *device_arrays)``;
         returns the final dense state.  ``on_aux`` receives each
         step's (device-resident) aux pytree — fetch inside it only if
-        you can afford the sync."""
+        you can afford the sync.  ``on_step(state, steps_done)`` runs
+        after each step's sparse update retires — in strict mode the
+        table and the dense state are exactly step-consistent there
+        (the flash-checkpoint hook point); in pipelined mode one
+        update is still in flight (staleness 1), so mid-run
+        checkpoints should use strict mode."""
         if self.pipeline == "auto":
             # probe strictly, then commit: a tiny host fraction means
             # double buffering only adds overhead (VERDICT r4 weak #3
@@ -151,14 +182,14 @@ class SparseTrainPipeline:
             # self.stats for the overlap report)
             it = iter(batches)
             warmup = list(itertools.islice(it, 1))
-            state = self._run_strict(state, warmup, on_aux)
+            state = self._run_strict(state, warmup, on_aux, on_step)
             base = {
                 k: self.stats[k]
                 for k in ("gather_s", "update_s", "dispatch_s",
                           "fetch_s")
             }
             probe = list(itertools.islice(it, 3))
-            state = self._run_strict(state, probe, on_aux)
+            state = self._run_strict(state, probe, on_aux, on_step)
             host = (
                 self.stats["gather_s"] - base["gather_s"]
                 + self.stats["update_s"] - base["update_s"]
@@ -171,13 +202,13 @@ class SparseTrainPipeline:
                 "pipelined" if frac >= 0.2 else "strict"
             )
             if self.chosen_mode == "pipelined":
-                return self._run_pipelined(state, it, on_aux)
-            return self._run_strict(state, it, on_aux)
+                return self._run_pipelined(state, it, on_aux, on_step)
+            return self._run_strict(state, it, on_aux, on_step)
         if self.pipeline:
-            return self._run_pipelined(state, batches, on_aux)
-        return self._run_strict(state, batches, on_aux)
+            return self._run_pipelined(state, batches, on_aux, on_step)
+        return self._run_strict(state, batches, on_aux, on_step)
 
-    def _run_strict(self, state, batches, on_aux):
+    def _run_strict(self, state, batches, on_aux, on_step=None):
         import jax.numpy as jnp
 
         t_wall = time.perf_counter()
@@ -193,10 +224,12 @@ class SparseTrainPipeline:
             self.stats["steps"] += 1
             if on_aux is not None:
                 on_aux(aux)
+            if on_step is not None:
+                on_step(state, int(self.stats["steps"]))
         self.stats["wall_s"] += time.perf_counter() - t_wall
         return state
 
-    def _run_pipelined(self, state, batches, on_aux):
+    def _run_pipelined(self, state, batches, on_aux, on_step=None):
         import jax.numpy as jnp
 
         t_wall = time.perf_counter()
@@ -232,6 +265,10 @@ class SparseTrainPipeline:
             self.stats["steps"] += 1
             if on_aux is not None:
                 on_aux(aux)
+            if on_step is not None:
+                # staleness 1: this step's own sparse update is still
+                # in flight — documented in :meth:`run`
+                on_step(state, int(self.stats["steps"]))
             if nxt is None:
                 break
             cur, emb = nxt, next_emb

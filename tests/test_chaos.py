@@ -176,6 +176,27 @@ def test_probabilistic_trigger_is_seed_deterministic():
     assert 3 <= len(t1) <= 27  # p=0.4 over 30 draws, loose bounds
 
 
+def test_after_step_threshold_trigger():
+    """after_step fires on ctx step >= N — the progress-based kill
+    trigger for SAMPLED step observations (the agent.monitor hook
+    reports the step it last saw, which can skip values an at_step
+    equality would wait on forever); a missing step never fires."""
+    spec = {
+        "name": "t", "seed": 0,
+        "rules": [{"point": "agent.monitor", "action": "delay",
+                   "after_step": 6, "args": {"seconds": 0.0}}],
+    }
+    inj = ChaosInjector(spec)
+    inj.fire("agent.monitor")               # no step in ctx
+    inj.fire("agent.monitor", step=None)    # trainer not started
+    inj.fire("agent.monitor", step=5)
+    assert inj.timeline_keys() == []
+    inj.fire("agent.monitor", step=7)       # skipped right past 6
+    assert [k[4] for k in inj.timeline_keys()] == [7]
+    inj.fire("agent.monitor", step=8)       # max_count=1 exhausted
+    assert len(inj.timeline_keys()) == 1
+
+
 def test_after_calls_and_max_count():
     spec = {
         "name": "t", "seed": 0,

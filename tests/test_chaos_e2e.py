@@ -317,6 +317,107 @@ def test_elastic_resize_churn(tmp_path):
     ), restores
 
 
+def test_sparse_kill_restore(tmp_path):
+    """ISSUE 9 acceptance (tier-1): SIGKILL a DeepFM job whose
+    embedding + GroupAdam slot tables live in host KvVariable tables
+    with an ACTIVE spill tier.  The sparse state must ride the flash
+    checkpoint: the restored incarnation's loss trajectory equals the
+    uninterrupted control (a lost row/freq/moment forks it at the
+    first replayed step) and the kv_checkpoint digests prove every
+    row, frequency count and optimizer slot bit-identical through
+    the cycle — all decided from telemetry events alone."""
+    report = harness.run_scenario(
+        scenarios.sparse_kill_restore(seed=61),
+        workdir=str(tmp_path / "run"),
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    # exactly one seeded kill, mid-step, in the window
+    assert len(report.timeline) == 1, report.timeline
+    _seq, point, _rule, action, step = report.timeline[0]
+    assert point == "trainer.step" and action == "kill"
+    assert 5 <= step <= 7
+    # the spill tier was genuinely active at export time
+    exports = [
+        e for e in report.events
+        if e.get("type") == "kv_checkpoint"
+        and e.get("stage") == "export"
+    ]
+    assert exports and any(e["spilled_rows"] > 0 for e in exports)
+    # same-world restore: own shard verbatim, never a reshard
+    restores = [
+        e for e in report.events
+        if e.get("type") == "kv_checkpoint"
+        and e.get("stage") == "restore"
+    ]
+    assert restores and all(
+        not e.get("resharded") for e in restores
+    ), restores
+    # the run really finished
+    steps = scenarios.RUN_OPTIONS["sparse-kill-restore"][
+        "total_steps"
+    ]
+    final_step, shards = read_last_checkpoint(
+        str(tmp_path / "run" / "ckpt")
+    )
+    assert final_step == steps and 0 in shards
+
+
+def test_sparse_spill_io_error_graceful(tmp_path):
+    """ISSUE 9 acceptance (tier-1): the spill tier's disk dies DURING
+    a checkpoint export.  Graceful degradation, not corruption: the
+    stranded cold rows drop out of that export (lost_rows stamped),
+    the production write-failure breaker trips on the next spill pass
+    (spill_disabled on a later export), the DRAM-resident rows still
+    commit, and the post-kill restore round-trips the post-fault
+    export bit-exact (KvStateRoundTrip invariant)."""
+    report = harness.run_scenario(
+        scenarios.sparse_spill_io_error(seed=67),
+        workdir=str(tmp_path / "run"),
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    actions = sorted(t[3] for t in report.timeline)
+    assert actions == ["io_error", "kill"], report.timeline
+    exports = [
+        e for e in report.events
+        if e.get("type") == "kv_checkpoint"
+        and e.get("stage") == "export"
+    ]
+    assert any(e.get("lost_rows", 0) > 0 for e in exports), exports
+    assert any(e.get("spill_disabled") for e in exports), exports
+
+
+@pytest.mark.slow
+def test_sparse_resize_churn(tmp_path):
+    """ISSUE 9 acceptance (slow): the genuinely novel combination —
+    a 2-node sparse job whose hash-partitioned KvVariable embedding
+    survives a world 2 -> 1 -> 2 churn.  Each world change must
+    RESHARD the hash table from committed storage (all old ranks' kv
+    shards read, rows repartitioned by key hash, owned subsets
+    imported) with exactly-once row accounting, the shm tier refused
+    across world sizes, and the dense loss trajectory still equal to
+    the uninterrupted control."""
+    report = harness.run_elastic_resize_scenario(
+        scenarios.sparse_resize_churn(seed=71),
+        workdir=str(tmp_path / "run"),
+        nnodes=2,
+    )
+    assert report.ok, report.summary()
+    kills = [t for t in report.timeline if t[3] == "kill_node"]
+    assert len(kills) == 1, report.timeline
+    # both directions resharded the kv state (2->1 and 1->2), and
+    # every cross-world restore came from committed storage
+    reshards = [
+        e for e in report.events
+        if e.get("type") == "kv_checkpoint"
+        and e.get("stage") == "restore" and e.get("resharded")
+    ]
+    worlds = {e["world_size"] for e in reshards}
+    assert worlds == {1, 2}, reshards
+    assert all(e.get("tier") == "storage" for e in reshards)
+
+
 @pytest.mark.slow
 def test_multinode_hang_culprit_restart(tmp_path):
     """ROADMAP carried-forward satellite: the culprit-selection

@@ -606,6 +606,11 @@ def test_terminal_decision_survives_master_restart(k8s, tmp_path):
         kv_store = type(
             "K", (), {"load": staticmethod(lambda d: None)}
         )()
+        resize_coordinator = type(
+            "R", (), {
+                "reconcile_after_replay": staticmethod(lambda: None),
+            },
+        )()
         recoveries = 0
 
     restore_master(_Shim, replayed)
