@@ -372,10 +372,23 @@ def bench_xl_train_step(jax, results: dict):
 def bench_xl_act_offload(jax, results: dict):
     """Selective activation offload (reference:
     selective_offloading_checkpoint.py:1): the lever exists to fit
-    shapes plain remat cannot — push GPT-2-XL to seq 2048 and run
-    both remat policies; whichever OOMs is recorded honestly.  Own
+    shapes plain remat cannot — push an XL-class model to seq 2048 and
+    run both remat policies; whichever OOMs is recorded honestly.  Own
     section: XL compiles through the tunnel are minutes, and this
-    experiment must not time out the headline XL numbers."""
+    experiment must not time out the headline XL numbers.
+
+    Root-cause of three rounds of silent budget kills (r3-r5): the
+    FULL 48-layer GPT-2-XL's offload-policy compile alone exceeds the
+    360 s section budget through the device tunnel, so the r3-era
+    budget gate (which only guarded the SECOND leg) never fired — the
+    section died mid-first-leg with nothing but the config keys
+    dumped.  Fix: (a) the default config is a HALF-DEPTH 24-layer
+    XL slice (same width/heads/seq — the offload-vs-remat comparison
+    is per-layer, so halving depth halves compile and step cost
+    without changing what is being compared; ``BENCH_XL_OFFLOAD_LAYERS``
+    restores the full model on boxes that can afford it), and (b) BOTH
+    legs are budget-gated with an explicit skip reason, so a tight
+    budget now yields a labeled partial result instead of a kill."""
     from functools import partial
 
     import jax.numpy as jnp
@@ -392,10 +405,14 @@ def bench_xl_act_offload(jax, results: dict):
 
     if os.getenv("BENCH_SMOKE"):
         return
+    try:
+        num_layers = int(os.getenv("BENCH_XL_OFFLOAD_LAYERS", "24"))
+    except ValueError:
+        num_layers = 24
 
     def try_xl(seq2, batch2, policy):
         cfg2 = GPTConfig(
-            num_layers=48, num_heads=25, hidden_dim=1600,
+            num_layers=num_layers, num_heads=25, hidden_dim=1600,
             max_seq_len=seq2, attention_impl="flash", remat=True,
             remat_policy=policy, param_dtype=jnp.bfloat16,
         )
@@ -435,8 +452,33 @@ def bench_xl_act_offload(jax, results: dict):
     # section regularly outlives its budget through the tunnel, and
     # the child's periodic state dump must preserve a completed
     # offload leg even when the control leg's kill arrives
-    out = {"model": "gpt2_xl", "seq_len": seq2, "batch": batch2}
+    out = {
+        "model": f"gpt2_xl_{num_layers}L",
+        "num_layers": num_layers,
+        "seq_len": seq2, "batch": batch2,
+    }
     results["xl_act_offload"] = out
+    # gate the FIRST leg too: its compile through the tunnel is the
+    # term that killed r3-r5, and a leg that cannot finish before the
+    # subprocess SIGKILL should be an explicit skip, not a corpse.
+    # The estimate is env-tunable (measured wall of a warm full-depth
+    # leg on the r5 box was >360s; the 24-layer default roughly
+    # halves it)
+    try:
+        est_first = float(os.getenv("BENCH_XL_LEG_EST_S", "150"))
+    except ValueError:
+        est_first = 150.0
+    rem = _section_remaining()
+    if rem < est_first:
+        out["offload"] = {
+            "ok": False,
+            "skipped": (
+                f"budget: {rem:.0f}s left < ~{est_first:.0f}s "
+                "offload leg (BENCH_XL_LEG_EST_S)"
+            ),
+        }
+        out["partial"] = True
+        return
     t_leg = time.time()
     out["offload"] = try_xl(seq2, batch2, "offload")
     leg_s = time.time() - t_leg
@@ -804,6 +846,47 @@ def bench_sparse_kv(jax, results: dict):
 
     pipelined = run_tier(True)
     strict = run_tier(False)
+
+    # (d) kv flash-checkpoint cost (ROADMAP item 2 follow-on): how
+    # long the table + GroupAdam slot export that rides EVERY sparse
+    # save takes, and how long the import on the restore side — on
+    # the real table the rate benches above populated
+    from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+
+    adapter = SparseStateAdapter(digest=False)
+    adapter.register_optimizer(opt)
+    t0 = time.perf_counter()
+    kv_state = adapter.export_state(step=1, rank=0)
+    kv_export_s = time.perf_counter() - t0
+    kv_rows = len(table)
+    kv_bytes = sum(
+        sum(a.nbytes for a in blobs.values())
+        for name, blobs in kv_state.items()
+        if isinstance(blobs, dict) and "keys" in blobs
+    )
+    fresh_table = KvVariable(
+        dim=dim, initial_capacity=1 << 16, name=table.name
+    )
+    fresh_opt = GroupAdamOptimizer(fresh_table, learning_rate=1e-2)
+    fresh = SparseStateAdapter(digest=False)
+    fresh.register_optimizer(fresh_opt)
+    t0 = time.perf_counter()
+    fresh.import_state(kv_state, tier="bench", step=1, rank=0)
+    kv_restore_s = time.perf_counter() - t0
+    kv_detail = {
+        "export_s": round(kv_export_s, 4),
+        "restore_s": round(kv_restore_s, 4),
+        "rows": int(kv_rows),
+        "mb": round(kv_bytes / 2**20, 1),
+        "export_MBps": round(
+            kv_bytes / 2**20 / max(kv_export_s, 1e-9), 1
+        ),
+        "restore_MBps": round(
+            kv_bytes / 2**20 / max(kv_restore_s, 1e-9), 1
+        ),
+        "tables": "embedding + group-adam m/v slots",
+    }
+
     results["sparse_kv"] = {
         "dim": dim,
         "batch_keys": B,
@@ -814,6 +897,7 @@ def bench_sparse_kv(jax, results: dict):
         "host_Mlookups_per_s": round(B / step_dt / 1e6, 3),
         "bytes_per_gather_mb": round(B * dim * 4 / 2**20, 2),
         "spill_tier": spill_detail,
+        "kv_checkpoint": kv_detail,
         "deepfm_e2e": {
             "model": "deepfm 26 sparse fields, dim 16",
             "batch": batch,
@@ -1325,6 +1409,21 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
     f_sync_post, _ = sync_save()
     f_sync = (f_sync_pre + f_sync_post) / 2
     d2h_mbps = state_bytes / 2**20 / max(t_d2h, 1e-9)
+    # raw host memcpy bandwidth on THIS box, measured the moment the
+    # restore ran: the shm restore's assemble stage copies each byte
+    # exactly once, so assemble_s ~= bytes / this number means the
+    # residual is the host's memory bandwidth (an irreducible term),
+    # while assemble_s >> it means faults/contention are still in
+    # play — the breakdown is provable either way (ISSUE 10)
+    import numpy as _np
+
+    _src = _np.ones(64 * 2**20, dtype=_np.uint8)
+    _dst = _np.empty_like(_src)
+    _dst[:] = _src  # warm both buffers
+    t0 = time.perf_counter()
+    _dst[:] = _src
+    memcpy_mbps = 64.0 / max(time.perf_counter() - t0, 1e-9)
+    del _src, _dst
     results["_speedup"] = f_sync / max(f_flash, 1e-9)
     results["flash_ckpt"] = {
         "sync_save_s": round(f_sync, 3),
@@ -1349,6 +1448,7 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
             state_bytes / 2**20 / max(restore_disk_s, 1e-9), 1
         ),
         "restore_disk_phases": restore_disk_phases,
+        "memcpy_baseline_MBps": round(memcpy_mbps, 1),
         "save_phases": dict(engine.last_save_phases),
         "state_mb": round(state_bytes / 2**20, 1),
         "num_params": count_params(params),
@@ -1360,8 +1460,13 @@ def bench_flash_ckpt(jax, results: dict, workdir: str):
 
 # One elastic train script for the recovery bench AND the e2e tests
 # (tests/test_e2e_elastic.py imports it) — a single source of truth
-# for the crash/restore flow.  argv: ckpt_dir crash_flag
-# restored_flag crash_mode(exit|kill)
+# for the crash/restore flow.  Every incarnation runs the
+# RecoveryProfiler: restore overlaps the model/step build via
+# load_checkpoint_async, the first step's trace+compile is bracketed
+# as the retrace phase (compile-cache hit/miss witnessed from the
+# cache dir), and the whole death->first-step budget lands as
+# recovery_phase events the bench section parses.  argv: ckpt_dir
+# crash_flag restored_flag crash_mode(exit|kill)
 ELASTIC_TRAIN_SCRIPT = r'''
 import os, sys, time
 import jax
@@ -1375,8 +1480,15 @@ from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
 from dlrover_tpu.trainer.elastic_trainer import (
     ElasticTrainer, TrainState, make_train_step,
 )
+from dlrover_tpu.trainer.recovery import RecoveryProfiler
 
 ckpt_dir, crash_flag, restored_flag, crash_mode = sys.argv[1:5]
+
+prof = RecoveryProfiler()
+# restore overlap: read/assemble run on a background thread while the
+# model/optimizer/jitted step are built below
+ckpt = Checkpointer(ckpt_dir)
+load_handle = ckpt.load_checkpoint_async()
 
 cfg = GPTConfig.tiny()
 model = GPT(cfg)
@@ -1387,8 +1499,8 @@ def loss_fn(p, batch):
     return cross_entropy_loss(logits, batch["y"])
 
 step_fn = make_train_step(loss_fn, optimizer)
-ckpt = Checkpointer(ckpt_dir)
-start_step, restored = ckpt.load_checkpoint()
+start_step, restored = load_handle.result()
+prof.record_restore(ckpt.last_restore_phases)
 if start_step is None:
     params = model.init_params(jax.random.PRNGKey(0))
     start_step = 0
@@ -1403,12 +1515,20 @@ rng = np.random.default_rng(0)
 data = rng.integers(0, cfg.vocab_size, (8, 17), dtype=np.int32)
 batch = {"x": jnp.asarray(data[:, :-1]), "y": jnp.asarray(data[:, 1:])}
 
+_needs_retrace = True
 for i in range(start_step, 5):
     with trainer.profile("h2d"):
         batch = {"x": jnp.asarray(data[:, :-1]),
                  "y": jnp.asarray(data[:, 1:])}
     with trainer.profile("compute") as _p:
-        state, metrics = step_fn(state, batch)
+        if _needs_retrace:
+            with prof.measured_retrace() as r:
+                state, metrics = step_fn(state, batch)
+                r.block(metrics)
+            _needs_retrace = False
+            prof.record_first_step()
+        else:
+            state, metrics = step_fn(state, batch)
         _p.block(metrics)
     trainer.report_step(metrics)
     ckpt.save_checkpoint(
@@ -1840,7 +1960,19 @@ def bench_goodput_churn(results: dict, workdir: str):
 def bench_elastic_recovery(results: dict, workdir: str):
     """Crash -> agent restart -> shm restore -> first new step, on the
     CPU mesh via the real tpurun supervision path (the north-star
-    story: fast recovery is what goodput under churn is made of)."""
+    story: fast recovery is what goodput under churn is made of).
+
+    Runs the PRODUCTION recovery posture — warm forks with the
+    framework preloaded, the job-keyed persistent compile cache, the
+    shm prefetch/pre-fault overlap and the overlapped breakpoint save
+    — and reports the measured per-phase budget
+    (spawn/import/restore/retrace/first_step) plus the compile-cache
+    hit/miss per recovery cycle, parsed from the run's own
+    recovery_phase/compile_cache events.  ``recovery_s`` stays the
+    driver-comparable end-to-end number (crash-flag mtime to
+    restored-flag mtime)."""
+    from dlrover_tpu.agent.forkserver import TRAINER_PRELOAD
+
     recovery_dir = os.path.join(workdir, "recovery")
     os.makedirs(recovery_dir, exist_ok=True)
     script = os.path.join(recovery_dir, "train.py")
@@ -1849,17 +1981,24 @@ def bench_elastic_recovery(results: dict, workdir: str):
     ckpt_dir = os.path.join(recovery_dir, "ckpt")
     crash_flag = os.path.join(recovery_dir, "crashed")
     restored_flag = os.path.join(recovery_dir, "restored")
+    event_log = os.path.join(recovery_dir, "events.jsonl")
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         PYTHONPATH=os.getcwd(),
         DLROVER_SHARED_DIR=os.path.join(recovery_dir, "sock"),
+        DLROVER_EVENT_LOG=event_log,
+        DLROVER_COMPILE_CACHE_DIR=os.path.join(
+            recovery_dir, "jax_cache"
+        ),
+        DLROVER_MONITOR_REPORT_INTERVAL="0.5",
+        DLROVER_PRELOAD=TRAINER_PRELOAD,
     )
     proc = _register_proc(subprocess.Popen(
         [
             sys.executable, "-m", "dlrover_tpu.run",
             "--nproc_per_node=1", "--max_restarts=2",
-            "--monitor_interval=0.3",
+            "--monitor_interval=0.1", "--warm-restart",
             script, ckpt_dir, crash_flag, restored_flag, "kill",
         ],
         env=env, cwd=os.getcwd(), stdout=subprocess.PIPE,
@@ -1880,10 +2019,44 @@ def bench_elastic_recovery(results: dict, workdir: str):
     recovery_s = os.path.getmtime(restored_flag) - os.path.getmtime(
         crash_flag
     )
-    results["elastic_recovery"] = {
+    out = {
         "recovery_s": round(recovery_s, 2),
-        "flow": "SIGKILL -> agent restart -> shm restore -> next step",
+        "flow": "SIGKILL -> warm fork + cache-hit retrace + "
+        "overlapped shm restore -> next step",
     }
+    # per-cycle budget from the run's own telemetry (no jax import —
+    # the timeline module is event-plumbing only)
+    try:
+        from dlrover_tpu.telemetry.events import read_events
+        from dlrover_tpu.telemetry.timeline import recovery_budgets
+
+        budgets = recovery_budgets(read_events(event_log))
+        cycles = {
+            f"restart{count}": {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in phases.items()
+            }
+            for (_rank, count), phases in sorted(budgets.items())
+            if count > 0
+        }
+        if cycles:
+            out["cycles"] = cycles
+            retraces = [
+                c["retrace"] for c in cycles.values()
+                if "retrace" in c
+            ]
+            if retraces:
+                out["retrace_s"] = max(retraces)
+            hits = [
+                c.get("compile_cache_hit") for c in cycles.values()
+                if "compile_cache_hit" in c
+            ]
+            if hits:
+                out["cache_hits"] = sum(1 for h in hits if h)
+                out["cache_misses"] = sum(1 for h in hits if not h)
+    except Exception as e:  # noqa: BLE001 - breakdown is best-effort
+        out["phases_error"] = f"{type(e).__name__}: {e}"
+    results["elastic_recovery"] = out
 
 
 _EMIT_LOCK = threading.Lock()
@@ -1995,6 +2168,44 @@ def _headline(snapshot: dict) -> dict:
     put(
         "elastic_recovery_s",
         _dig(snapshot, "elastic_recovery", "recovery_s"),
+    )
+    # invisible-recovery breakdown: the measured death->first-step
+    # budget of the first recovery cycle, the retrace term and the
+    # compile-cache witness — the numbers that make the residual
+    # provable instead of guessed (ISSUE 10).  Flattened to compact
+    # STRINGS: the headline contract is scalars-only (VERDICT r5 #10,
+    # pinned by test_bench_guard)
+    cycle = _dig(snapshot, "elastic_recovery", "cycles", "restart1")
+    if isinstance(cycle, dict):
+        h["recovery_phases"] = " ".join(
+            f"{p}={cycle[p]:.2f}"
+            for p in ("spawn", "import", "restore", "retrace",
+                      "first_step")
+            if isinstance(cycle.get(p), (int, float))
+        )
+    put("retrace_s", _dig(snapshot, "elastic_recovery", "retrace_s"))
+    hits = _dig(snapshot, "elastic_recovery", "cache_hits")
+    misses = _dig(snapshot, "elastic_recovery", "cache_misses")
+    if hits is not None or misses is not None:
+        h["compile_cache"] = f"{hits or 0}h/{misses or 0}m"
+    shm_phases = _dig(snapshot, "flash_ckpt", "restore_shm_phases")
+    if isinstance(shm_phases, dict):
+        h["flash_restore_phases"] = " ".join(
+            f"{k[:-2]}={shm_phases[k]:.2f}"
+            for k in ("read_s", "assemble_s", "h2d_s")
+            if isinstance(shm_phases.get(k), (int, float))
+        )
+    put(
+        "restore_memcpy_MBps",
+        _dig(snapshot, "flash_ckpt", "memcpy_baseline_MBps"),
+    )
+    put(
+        "kv_export_s",
+        _dig(snapshot, "sparse_kv", "kv_checkpoint", "export_s"),
+    )
+    put(
+        "kv_restore_s",
+        _dig(snapshot, "sparse_kv", "kv_checkpoint", "restore_s"),
     )
     errors = sorted(
         k[: -len("_error")] for k in snapshot if k.endswith("_error")

@@ -36,6 +36,18 @@ from dlrover_tpu.common.log import default_logger as logger
 
 DEFAULT_PRELOAD = "jax,jax.numpy,flax,optax,numpy"
 
+# the warm-restart recovery posture: everything the respawned trainer
+# imports on its critical path, baked into the template ONCE — the
+# single source the chaos scenarios and bench.py share, so the module
+# set they measure cannot silently drift apart
+TRAINER_PRELOAD = (
+    DEFAULT_PRELOAD
+    + ",dlrover_tpu.checkpoint.checkpointer"
+    + ",dlrover_tpu.trainer.elastic_trainer"
+    + ",dlrover_tpu.trainer.recovery"
+    + ",dlrover_tpu.models.gpt"
+)
+
 # jax freezes env-derived config at import, which happens in the
 # TEMPLATE; a forked worker whose env differs must push these through
 # the config API or e.g. the persistent compilation cache silently

@@ -11,6 +11,7 @@ import os
 import socket
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import env_utils
@@ -80,6 +81,11 @@ class MasterClient:
         self._last_reported_step = 0
         self._last_acked_dataset = ""
         self._last_acked_task = -1
+        # bounded ack history for resync reconciliation: the mirror
+        # can lose EVERY ack inside one group-commit window (0.25 s
+        # default), not just the last — 64 spans that window at any
+        # plausible ack rate
+        self._recent_acks: deque = deque(maxlen=64)
         self._master_incarnation = ""
         self._client.set_session_resync(self._session_resync)
 
@@ -97,6 +103,7 @@ class MasterClient:
                 last_step=self._last_reported_step,
                 last_acked_dataset=self._last_acked_dataset,
                 last_acked_task=self._last_acked_task,
+                recent_acked_tasks=list(self._recent_acks),
             )
         )
         recovered = bool(
@@ -287,6 +294,7 @@ class MasterClient:
         if ok and success:
             self._last_acked_dataset = dataset_name
             self._last_acked_task = task_id
+            self._recent_acks.append((dataset_name, task_id))
         return ok
 
     @retry_request

@@ -18,7 +18,6 @@ import os
 import signal
 import subprocess
 import sys
-import tempfile
 import time
 from dataclasses import dataclass, field
 from enum import Enum
@@ -220,6 +219,13 @@ class ElasticTrainingAgent:
         # re-form) must not eat the failure budget
         self._restart_count = 0
         self._budget_restarts = 0
+        # wall clock at which THIS restart round's death was
+        # witnessed: exported as DLROVER_RECOVERY_T0 so the respawned
+        # trainer's RecoveryProfiler measures the real spawn phase
+        self._recovery_t0: float = 0.0
+        # previous round's overlapped breakpoint save, joined before
+        # the next round may start another
+        self._save_thread = None
         self._procs: List[subprocess.Popen] = []
         self._rdzv = MasterRendezvousHandler(
             RendezvousName.ELASTIC_TRAINING,
@@ -307,14 +313,21 @@ class ElasticTrainingAgent:
 
     @staticmethod
     def _compile_cache_env() -> Dict[str, str]:
-        return {
-            "JAX_COMPILATION_CACHE_DIR": os.path.join(
-                tempfile.gettempdir(),
-                f"dlrover_jax_cache_{os.getuid()}",
-            ),
-            "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
-            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.0",
-        }
+        """Persistent-compile-cache env every incarnation shares:
+        keyed off the JOB (not the uid) so a replacement host resolves
+        the same directory and the first incarnation's compile
+        pre-populates every later one's retrace (see
+        :mod:`dlrover_tpu.common.compile_cache`); the directory is
+        created HERE so the first worker's jax import finds it armed
+        rather than silently disabling the cache."""
+        from dlrover_tpu.common.compile_cache import cache_env
+
+        env = cache_env()
+        try:
+            os.makedirs(env["JAX_COMPILATION_CACHE_DIR"], exist_ok=True)
+        except OSError:
+            pass
+        return env
 
     def _worker_env(
         self, outcome: RendezvousOutcome, local_rank: int
@@ -340,6 +353,14 @@ class ElasticTrainingAgent:
         # agent-side; a recompile is seconds)
         for key, val in self._compile_cache_env().items():
             env.setdefault(key, val)
+        # the wall clock at which THIS round's death was witnessed:
+        # the respawned trainer's RecoveryProfiler anchors its spawn
+        # phase on it, so the measured budget covers the whole
+        # death->first-step chain, not just what the trainer can see
+        if self._recovery_t0 > 0:
+            env["DLROVER_RECOVERY_T0"] = f"{self._recovery_t0:.6f}"
+        else:
+            env.pop("DLROVER_RECOVERY_T0", None)
         # tag the worker's training events even when the entrypoint
         # never touches telemetry itself
         env.setdefault(EVENT_SOURCE_ENV, "trainer")
@@ -529,6 +550,9 @@ class ElasticTrainingAgent:
         threading.Thread(
             target=report, daemon=True, name="preemption-report"
         ).start()
+        # an overlapped persist from an earlier restart must not race
+        # this save of the same shards
+        self._join_save_thread()
         self._save_ckpt_at_breakpoint()
 
     # -- health check -------------------------------------------------------
@@ -597,6 +621,9 @@ class ElasticTrainingAgent:
         try:
             return self._invoke_run()
         finally:
+            # an overlapped breakpoint persist must finish before the
+            # saver (and its shm handlers) are torn down
+            self._join_save_thread()
             for m in self._monitors:
                 m.stop()
             if dumper is not None:
@@ -614,7 +641,27 @@ class ElasticTrainingAgent:
         outcome = self._rdzv.next_rendezvous()
         self._start_workers(outcome)
 
+    def _join_save_thread(self, timeout: float = 600.0):
+        """Wait for the previous round's overlapped breakpoint save —
+        called before starting another, and on every exit path, so an
+        in-flight persist can never race process teardown or a second
+        save of the same shards."""
+        t = self._save_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+        self._save_thread = None
+
+    @staticmethod
+    def _overlap_save_enabled() -> bool:
+        return os.getenv(
+            "DLROVER_OVERLAP_BREAKPOINT_SAVE", "1"
+        ).strip().lower() not in ("0", "false", "no", "off")
+
     def _restart_workers(self, reason: str = "failure"):
+        # the death was witnessed by the poll that got us here: this
+        # timestamp anchors the replacement trainer's recovery-phase
+        # budget (exported as DLROVER_RECOVERY_T0)
+        self._recovery_t0 = time.time()
         self._restart_count += 1
         if reason in ("failure", "hang"):
             self._budget_restarts += 1
@@ -629,7 +676,36 @@ class ElasticTrainingAgent:
             restart_count=self._restart_count,
             reason=reason,
         )
-        self._save_ckpt_at_breakpoint()
+        # restore prefetch hint (ROADMAP 3b): page the shm checkpoint
+        # segments in THE MOMENT the death is witnessed — the touches
+        # overlap the breakpoint save, the worker stop AND the
+        # replacement's import, instead of starting after the stop
+        # completed as they used to.
+        self._prefetch_shm_for_restore()
+        import threading
+
+        # a previous round's overlapped persist must be done before
+        # EITHER branch saves the same shards again
+        self._join_save_thread()
+        if reason in ("failure", "hang") and self._overlap_save_enabled():
+            # the respawned trainer restores from the SHM snapshot;
+            # the storage persist is pure durability (it protects
+            # against this agent dying too) and has no business on
+            # the death->first-step critical path — run it overlapped
+            # with the stop + rendezvous + spawn.  The shard lock
+            # keeps it consistent against any concurrent reader.
+            self._save_thread = threading.Thread(
+                target=self._save_ckpt_at_breakpoint,
+                daemon=True,
+                name="breakpoint-save",
+            )
+            self._save_thread.start()
+        else:
+            # planned drains (resize / membership): the re-formed
+            # world may RESHARD from the storage tier, so the persist
+            # must be durable before the new world restores — keep it
+            # on the critical path
+            self._save_ckpt_at_breakpoint()
         if reason == "resize":
             # drain fast: the old world is DEAD (its collective
             # partners changed), so a trainer wedged in a doomed
@@ -646,13 +722,6 @@ class ElasticTrainingAgent:
             )
         else:
             self._stop_workers()
-        # restore prefetch hint (ROADMAP 3b): page the shm checkpoint
-        # segments in WHILE the replacement trainer is still paying
-        # its interpreter/jax import cost — by the time it mmaps the
-        # snapshot, the pages are resident and the restore's
-        # fault-bound term is gone.  Background thread: the page
-        # touches must overlap the spawn, not precede it.
-        self._prefetch_shm_for_restore()
         self._initialize_workers()
         if self._hang_watchdog is not None:
             # the recovery window (respawn + restore + retrace) must
@@ -739,6 +808,7 @@ class ElasticTrainingAgent:
                         "master restart request",
                         self._spec.max_restarts,
                     )
+                    self._join_save_thread()
                     self._save_ckpt_at_breakpoint()
                     self._stop_workers()
                     self._client.ready_to_exit("failed")
@@ -778,6 +848,7 @@ class ElasticTrainingAgent:
                         "max restarts (%s) exhausted; giving up",
                         self._spec.max_restarts,
                     )
+                    self._join_save_thread()
                     self._save_ckpt_at_breakpoint()
                     self._stop_workers()
                     self._client.ready_to_exit("failed")
@@ -788,6 +859,7 @@ class ElasticTrainingAgent:
                 self._restart_workers(reason="membership")
 
     def stop(self):
+        self._join_save_thread()
         self._stop_workers()
         if self._forkserver is not None:
             self._forkserver.close()

@@ -8,10 +8,50 @@ the sharding story is a constructor flag, not a separate engine
 hierarchy, because on TPU both are just pytrees of ``jax.Array``.
 """
 
+import threading
 from enum import Enum
 from typing import Any, Optional, Tuple
 
 from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+
+class RestoreHandle:
+    """A restore running on a background thread, so its read/assemble
+    stages overlap the caller's own setup (model build, optimizer
+    init, jit trace) — the respawn-overlap half of invisible recovery.
+    ``result()`` joins and returns ``(step, state)`` exactly as the
+    synchronous call would (bit-identical: it IS the same code on
+    another thread; the overlap regression test pins this).
+
+    Not a ``concurrent.futures`` future on purpose: executor threads
+    are non-daemon, and a restore wedged on a dead storage tier must
+    never block process exit in this crash-heavy path."""
+
+    def __init__(self, fn, args=(), kwargs=None):
+        self._value: Optional[tuple] = None
+        self._exc: Optional[Exception] = None
+
+        def run():
+            try:
+                self._value = fn(*args, **(kwargs or {}))
+            except Exception as e:  # noqa: BLE001 - re-raised
+                self._exc = e
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="restore-async"
+        )
+        self._thread.start()
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None):
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("restore still running")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
 
 
 class StorageType(Enum):
@@ -146,6 +186,26 @@ class Checkpointer:
             if tier is not None:
                 return tier.restore()
         return step, state
+
+    def load_checkpoint_async(
+        self, target_state: Any = None, orbax_dir: str = "",
+    ) -> RestoreHandle:
+        """:meth:`load_checkpoint` on a background thread: start it
+        FIRST, build the model/optimizer/jitted step while the
+        read+assemble stages run, then ``handle.result()`` — only the
+        (device-bound) tail of the restore stays serial with the
+        caller.  One restore at a time: do not save or load through
+        this checkpointer until ``result()`` returned.
+
+        Note the host-array path (no ``target_state``) performs no
+        device transfers at all, so with enough setup work to hide
+        behind, the whole restore disappears from the critical path."""
+        return RestoreHandle(
+            self.load_checkpoint,
+            kwargs={
+                "target_state": target_state, "orbax_dir": orbax_dir,
+            },
+        )
 
     def wait(self, timeout: float = 600.0) -> bool:
         """Block until in-flight async snapshot writes reach shared

@@ -213,34 +213,28 @@ class AsyncCheckpointSaver:
         """Restore prefetch hint (ROADMAP 3b): touch every page of
         each shm snapshot so the segment is resident BEFORE the
         replacement trainer attaches it.  Called by the agent on a
-        daemon thread right after it stops the dead workers — the
-        page-ins overlap the new trainer's interpreter + jax import
-        (seconds), which previously hid nothing: the trainer paid the
-        fault-bound term itself inside the restore's assemble stage.
-        Read-only strided touches: data is discarded, only the page
-        mappings persist.  Returns bytes touched."""
+        daemon thread the moment a death is witnessed — the page-ins
+        overlap the breakpoint save, the worker stop AND the new
+        trainer's interpreter + jax import.  Read-only strided
+        touches on a PINNED thread budget (``prefault_workers``): the
+        prefetch exists to hide latency from the respawn, so it must
+        never out-compete the respawn for cores.  Returns bytes
+        touched."""
         saver = cls._instance
         if saver is None:
             return 0
-        import numpy as _np
+        from dlrover_tpu.checkpoint.shm_handler import prefault_workers
 
         t0 = time.time()
         touched = 0
         segments = 0
+        workers = prefault_workers()
         for handler in saver._shm_handlers:
             try:
-                meta = handler.metadata()
-                if not meta:
-                    continue
-                total = meta["scalar_offset"] + meta["scalar_nbytes"]
-                shm = handler._attach(min_size=total)
-                if shm is None:
-                    continue
-                _np.frombuffer(
-                    shm.buf, dtype=_np.uint8, count=total
-                )[::4096].sum()
-                touched += total
-                segments += 1
+                nbytes = handler.prefault(workers=workers)
+                if nbytes:
+                    touched += nbytes
+                    segments += 1
             except Exception:  # noqa: BLE001 - best-effort warmup
                 logger.exception("shm prefetch failed for a shard")
         seconds = time.time() - t0
@@ -464,6 +458,13 @@ class AsyncCheckpointSaver:
         deadline = time.time() + timeout
         expected = self.config.global_shard_num
         done: List[str] = []
+        # adaptive poll: single-node commits find every done file on
+        # the FIRST listdir (our own executor just wrote them — the
+        # wakeup is effectively event-driven); only a multi-node
+        # commit genuinely waits, and its cadence backs off from 20 ms
+        # to 500 ms instead of paying a flat half-second floor that
+        # used to sit on the recovery critical path
+        poll = 0.02
         while time.time() < deadline:
             # re-read each iteration: an elastic resize ships a new
             # SaverConfig through the FACTORY thread (which replaces
@@ -489,7 +490,8 @@ class AsyncCheckpointSaver:
                 _COMMITTED_STEP.set(step)
                 emit_event("checkpoint_commit", step=step)
                 return
-            time.sleep(0.5)
+            time.sleep(poll)
+            poll = min(0.5, poll * 1.7)
         _PERSIST_ERRORS_TOTAL.inc(reason="commit_timeout")
         logger.error(
             "commit of step %s timed out (%s/%s done files)",

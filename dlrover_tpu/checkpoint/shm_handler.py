@@ -444,6 +444,49 @@ class SharedMemoryHandler:
             return None, b"", {}
         return config, bytes(shm.buf[:total]), meta
 
+    def prefault(
+        self, workers: Optional[int] = None,
+        chunk_bytes: int = 64 * 2**20,
+    ) -> int:
+        """Touch every page of the snapshot so a later read runs warm.
+
+        Page-table population is PER PROCESS: the agent's prefetch
+        warms the agent, not the trainer — so the respawned trainer
+        runs this itself (engine construction kicks it on a daemon
+        thread) while its model build / jit trace proceeds.  Strided
+        read-only touches in parallel ~chunk_bytes pieces: numpy
+        releases the GIL for the reductions, so the faults overlap
+        across the (bounded) pool.  Returns bytes touched (0 when no
+        snapshot exists)."""
+        meta = self._meta.get(default_if_absent=True)
+        if not meta:
+            return 0
+        total = meta["scalar_offset"] + meta["scalar_nbytes"]
+        shm = self._attach(min_size=total)
+        if shm is None or total <= 0:
+            return 0
+        workers = workers if workers is not None else prefault_workers()
+        flat = np.frombuffer(shm.buf, dtype=np.uint8, count=total)
+
+        def touch(lo: int, hi: int):
+            flat[lo:hi:4096].sum()
+
+        spans = [
+            (lo, min(lo + chunk_bytes, total))
+            for lo in range(0, total, max(1, chunk_bytes))
+        ]
+        if workers <= 1 or len(spans) <= 1:
+            for lo, hi in spans:
+                touch(lo, hi)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="shm-prefault"
+            ) as pool:
+                list(pool.map(lambda s: touch(*s), spans))
+        return total
+
     def close(self):
         if self._shm is not None:
             self._shm.close()
@@ -454,6 +497,21 @@ class SharedMemoryHandler:
         if self._attach() is not None:
             self._shm.unlink()
             self._shm = None
+
+
+def prefault_workers() -> int:
+    """Thread budget for page-in prefetch/prefault work.  PINNED low
+    by default: the touches deliberately overlap the trainer's
+    interpreter/jax import (or its model build), and an unbounded pool
+    would starve exactly the work it is hiding latency from.
+    ``DLROVER_PREFETCH_WORKERS`` overrides."""
+    val = os.getenv("DLROVER_PREFETCH_WORKERS", "").strip()
+    if val:
+        try:
+            return max(1, int(val))
+        except ValueError:
+            pass
+    return min(4, max(1, (os.cpu_count() or 2) // 2))
 
 
 def _views_from(metas: Dict[str, TensorMeta], buf) -> Dict[str, np.ndarray]:

@@ -1,0 +1,109 @@
+"""Job-keyed persistent XLA compilation cache.
+
+Retrace is the last big serial term of a worker recovery: the
+respawned trainer re-traces its jitted step and, without a persistent
+compilation cache, re-COMPILES it — seconds on CPU, minutes for XL
+models through a device tunnel.  jax ships the cache
+(``jax_compilation_cache_dir``); what the elastic stack must supply is
+the *sharing contract*: every incarnation of a job — including a
+replacement worker on a different host after a resize — must resolve
+the SAME cache directory, so the first incarnation's compile
+pre-populates what every later one hits.
+
+Resolution order for :func:`job_cache_dir`:
+
+1. ``DLROVER_COMPILE_CACHE_DIR`` — the operator's explicit choice
+   (point it at job-shared storage for cross-host hits);
+2. an ambient ``JAX_COMPILATION_CACHE_DIR`` (the user already chose);
+3. ``<tmpdir>/dlrover_jax_cache_<job>`` keyed off the job identity
+   (``DLROVER_JOB_NAME`` or the IPC socket-dir hash — the same
+   namespace rule the shm segments use), so two jobs on one host
+   never share entries but every incarnation of one job does.
+
+Hit detection (:func:`cache_entries` + the trainer's retrace monitor)
+counts ``*-cache`` files: jax writes one per compiled executable and
+touches only the ``-atime`` sibling on a hit, so "no new entries
+across the first post-restore step" IS the cache-hit witness — checked
+from the filesystem, robust across jax versions.
+"""
+
+import os
+import tempfile
+from typing import Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+CACHE_DIR_ENV = "JAX_COMPILATION_CACHE_DIR"
+DLROVER_CACHE_DIR_ENV = "DLROVER_COMPILE_CACHE_DIR"
+
+# every executable should land in the cache: recovery needs the whole
+# step function back, not just the slow-to-compile subset
+_CACHE_TUNING = {
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.0",
+}
+
+
+def job_cache_dir() -> str:
+    """The cache directory every incarnation of this job shares."""
+    explicit = os.getenv(DLROVER_CACHE_DIR_ENV, "").strip()
+    if explicit:
+        return explicit
+    ambient = os.getenv(CACHE_DIR_ENV, "").strip()
+    if ambient:
+        return ambient
+    from dlrover_tpu.checkpoint.shm_handler import default_job_suffix
+
+    return os.path.join(
+        tempfile.gettempdir(),
+        f"dlrover_jax_cache_{default_job_suffix()}",
+    )
+
+
+def cache_env(cache_dir: str = "") -> Dict[str, str]:
+    """Env block a worker spawn exports so its jax import freezes the
+    shared cache on (the forkserver additionally pushes these through
+    ``jax.config`` for template forks whose jax imported earlier)."""
+    return {
+        CACHE_DIR_ENV: cache_dir or job_cache_dir(),
+        **_CACHE_TUNING,
+    }
+
+
+def enable_persistent_cache(cache_dir: str = "") -> str:
+    """In-process activation (idempotent): create the directory and
+    push the config through ``jax.config`` — the path for processes
+    whose jax imported before the env was exported.  Returns the
+    active directory, or ``""`` when jax refused the options."""
+    cache_dir = cache_dir or job_cache_dir()
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as e:
+        logger.warning(
+            "compile cache dir %s not creatable: %s", cache_dir, e
+        )
+        return ""
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", 0
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0
+        )
+    except Exception as e:  # noqa: BLE001 - old jax / no option
+        logger.warning("persistent compile cache unavailable: %s", e)
+        return ""
+    return cache_dir
+
+
+def cache_entries(cache_dir: Optional[str] = None) -> int:
+    """Number of compiled executables in the cache (``*-cache``
+    files; the ``-atime`` siblings are hit markers, not entries)."""
+    cache_dir = cache_dir if cache_dir is not None else job_cache_dir()
+    count = 0
+    for root, _dirs, files in os.walk(cache_dir):
+        count += sum(1 for f in files if f.endswith("-cache"))
+    return count

@@ -337,6 +337,12 @@ class SessionResyncRequest(Message):
     last_step: int = 0
     last_acked_dataset: str = ""
     last_acked_task: int = -1
+    # every ack the mirror's group-commit lag could have lost — the
+    # single last_acked_* pair (kept for older agents) misses earlier
+    # acks when several complete inside one commit window
+    recent_acked_tasks: List[Tuple[str, int]] = field(
+        default_factory=list
+    )
 
 
 @dataclass

@@ -31,10 +31,29 @@ Sequence numbers make snapshot+log replay idempotent: the snapshot
 stores the seq it folded in, and replay skips log records at or below
 it, so a crash between "snapshot renamed" and "log truncated" cannot
 double-apply entries.
+
+**Mirror (host-portable control plane).**  The local journal makes the
+master crash-safe; it does not make it *host-portable* — a replacement
+master on a different machine cannot read a dead host's local disk.
+``DLROVER_MASTER_JOURNAL_MIRROR_DIR`` points the journal at a second
+directory on the checkpoint storage tier (the one filesystem every
+deployment already shares): appends are batched to it by a daemon
+thread with **async group commit** — one write+fsync per batch every
+``DLROVER_JOURNAL_MIRROR_INTERVAL_S`` (default 0.25 s) — so the hot
+path's per-append fsync never waits on the (possibly remote) mirror.
+The mirror therefore lags the local log by at most one group-commit
+window; its tail may be torn mid-frame, and replay's prefix
+consistency handles both — a mirror restore is simply a restore of a
+slightly older, equally-consistent journal.  A master spawned with a
+FRESH local journal dir and the mirror dir configured seeds the local
+dir from the mirror before replaying — that is the respawn-on-a-
+different-host path (the last single-host dependency in the recovery
+story).
 """
 
 import json
 import os
+import shutil
 import struct
 import threading
 import time
@@ -48,6 +67,8 @@ from dlrover_tpu.telemetry.events import emit_event
 from dlrover_tpu.telemetry.metrics import get_registry
 
 JOURNAL_DIR_ENV = "DLROVER_MASTER_JOURNAL_DIR"
+JOURNAL_MIRROR_DIR_ENV = "DLROVER_MASTER_JOURNAL_MIRROR_DIR"
+JOURNAL_MIRROR_INTERVAL_ENV = "DLROVER_JOURNAL_MIRROR_INTERVAL_S"
 
 MAGIC = b"DLRVJRN1\n"
 _REC = struct.Struct(">II")  # payload length, CRC32(payload)
@@ -66,6 +87,15 @@ _FSYNC_SECONDS = _REG.histogram(
 _SNAPSHOTS_TOTAL = _REG.counter(
     "dlrover_master_journal_snapshots_total",
     "Full-state snapshots written (log rotations)",
+)
+_MIRROR_FLUSH_SECONDS = _REG.histogram(
+    "dlrover_master_journal_mirror_flush_seconds",
+    "One async group commit of pending records to the journal mirror",
+)
+_MIRROR_LAG_SECONDS = _REG.gauge(
+    "dlrover_master_journal_mirror_lag_seconds",
+    "Age of the oldest record the mirror had not yet flushed at the "
+    "last group commit (bounded by the group-commit window)",
 )
 
 
@@ -185,23 +215,360 @@ def replay_dir(journal_dir: str) -> JournalReplay:
     return out
 
 
+def seed_journal_from_mirror(journal_dir: str, mirror_dir: str) -> bool:
+    """Copy the mirror's snapshot + log into an EMPTY local journal
+    dir — the different-host respawn path: the dead master's local
+    disk is gone, the storage-tier mirror is all that survives.  A
+    local dir that already has state wins (same-host respawn: the
+    local log is fresher than the lagging mirror); returns whether the
+    seed happened."""
+    local = replay_dir(journal_dir)
+    if local.has_state:
+        return False
+    mirrored = replay_dir(mirror_dir)
+    if not mirrored.has_state:
+        return False
+    os.makedirs(journal_dir, exist_ok=True)
+    for name in (_SNAP_NAME, _SNAP_NAME + ".bak", _LOG_NAME):
+        src = os.path.join(mirror_dir, name)
+        if not os.path.exists(src):
+            continue
+        tmp = os.path.join(journal_dir, name + ".seed")
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, os.path.join(journal_dir, name))
+    logger.warning(
+        "journal dir %s seeded from mirror %s (snapshot seq %s, "
+        "%s entries%s)",
+        journal_dir, mirror_dir, mirrored.snapshot_seq,
+        len(mirrored.entries),
+        ", torn tail discarded" if mirrored.truncated else "",
+    )
+    return True
+
+
+class _JournalMirror:
+    """Async group-commit replica of the journal in a second directory
+    (the checkpoint storage tier).  The hot append path only enqueues
+    the already-framed record bytes; a daemon thread batches pending
+    frames into ONE write+fsync per group-commit window, so mirror
+    latency never rides the RPC handlers the way the local fsync
+    (deliberately) does.  Rotation tasks rewrite the mirror atomically
+    the same way the local log rotates."""
+
+    def __init__(
+        self,
+        mirror_dir: str,
+        interval_s: float = 0.25,
+        local_dir: Optional[str] = None,
+    ):
+        self.dir = mirror_dir
+        self.interval_s = max(0.01, interval_s)
+        # the local journal this mirror replicates: the repair source
+        # when a flush fails (see _resync_from_local)
+        self._local_dir = local_dir
+        os.makedirs(mirror_dir, exist_ok=True)
+        self._log_path = os.path.join(mirror_dir, _LOG_NAME)
+        self._snap_path = os.path.join(mirror_dir, _SNAP_NAME)
+        # truncate any torn mirror tail NOW: appending after garbage
+        # would bury every later record past the point replay stops
+        existing = replay_dir(mirror_dir)
+        mode = "ab"
+        if not os.path.exists(self._log_path) or (
+            existing.good_offset < len(MAGIC)
+        ):
+            mode = "wb"
+        self._fh = open(self._log_path, mode)
+        if mode == "wb" or self._fh.tell() == 0:
+            self._fh.write(MAGIC)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        elif existing.good_offset < self._fh.tell():
+            self._fh.truncate(existing.good_offset)
+            self._fh.seek(0, os.SEEK_END)
+        # ordered task queue: ("append", frame, ts) | ("snapshot",
+        # doc_bytes, tail_bytes, ts); order preserved so a rotation
+        # never swallows an append that followed it
+        self._tasks: List[tuple] = []
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._wake = False
+        self._inflight = False
+        self._resync = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="journal-mirror"
+        )
+        self._thread.start()
+
+    # -- producer side (called under the journal's io lock) -------------
+
+    def enqueue_append(self, frame: bytes):
+        with self._cv:
+            self._tasks.append(("append", frame, time.monotonic()))
+            # no notify: appends ride the next interval tick — THAT is
+            # the group commit; only rotation/flush wake the thread
+
+    def enqueue_snapshot(self, doc: bytes, tail: bytes):
+        with self._cv:
+            self._tasks.append(
+                ("snapshot", doc, tail, time.monotonic())
+            )
+            self._wake = True
+            self._cv.notify()
+
+    def request_resync(self):
+        """Schedule a full rebuild of the mirror from the local
+        journal files — the repair path after a failed flush, and the
+        first-arming path when the local dir already has state the
+        mirror never saw."""
+        with self._cv:
+            self._resync = True
+
+    # -- consumer ---------------------------------------------------------
+
+    def _drain(self) -> List[tuple]:
+        with self._cv:
+            tasks, self._tasks = self._tasks, []
+        return tasks
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                # pace to the group-commit window even under a steady
+                # append stream — ONE write+fsync per interval, not
+                # one per fsync latency; rotation/flush/close bypass
+                # the wait via _wake
+                if not self._stopped and not self._wake:
+                    self._cv.wait(timeout=self.interval_s)
+                self._wake = False
+                if (
+                    self._stopped
+                    and not self._tasks
+                    and not self._resync
+                ):
+                    return
+            self._flush_once()
+
+    def _flush_once(self):
+        with self._cv:
+            self._inflight = True
+        try:
+            self._flush_batch()
+        finally:
+            with self._cv:
+                self._inflight = False
+                self._cv.notify_all()
+
+    def _flush_batch(self):
+        if self._resync:
+            # drain FIRST: every frame enqueued before this point is
+            # already in the local files the resync copies (the local
+            # append precedes the enqueue under the journal's io
+            # lock), so discarding here cannot open a gap — at worst
+            # a frame lands twice, which replay's seq filter skips
+            self._drain()
+            if not self._resync_from_local():
+                if self._stopped:
+                    # shutdown with the mirror tier dead: give up —
+                    # the mirror stays stale but consistent, and the
+                    # next incarnation's arming resyncs it
+                    with self._cv:
+                        self._resync = False
+                return
+            with self._cv:
+                self._resync = False
+        tasks = self._drain()
+        if not tasks:
+            return
+        t0 = time.monotonic()
+        oldest = min(t[-1] for t in tasks)
+        appended = 0
+        try:
+            batch = b""
+            for task in tasks:
+                if task[0] == "append":
+                    batch += task[1]
+                    appended += 1
+                    continue
+                # rotation: flush whatever preceded it, then rewrite
+                if batch:
+                    self._fh.write(batch)
+                    batch = b""
+                self._rotate(task[1], task[2])
+            if batch:
+                self._fh.write(batch)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except (OSError, ValueError):
+            # a browned-out mirror must not kill the thread: the local
+            # journal is still durable.  A partial write (or a rotate
+            # that died with the handle closed — hence ValueError)
+            # would leave a seq GAP if we just kept appending, and a
+            # gapped mirror is NOT a consistent prefix — so schedule a
+            # full resync from the local files instead; until it
+            # succeeds the mirror is stale but never inconsistent
+            logger.exception(
+                "journal mirror flush failed; mirror will resync "
+                "from the local journal"
+            )
+            with self._cv:
+                self._resync = True
+            return
+        lag = time.monotonic() - oldest
+        _MIRROR_FLUSH_SECONDS.observe(time.monotonic() - t0)
+        _MIRROR_LAG_SECONDS.set(lag)
+        emit_event(
+            "journal_mirror_flush",
+            records=appended,
+            lag_s=round(lag, 4),
+            dir=self.dir,
+        )
+
+    def _resync_from_local(self) -> bool:
+        """Rebuild the mirror as a byte copy of the local journal
+        (snapshot + ``.bak`` + the log's whole-frame prefix).  The log
+        copy is truncated at the last whole frame: a torn tail read
+        mid-append belongs to a record whose mirror enqueue happened
+        after the caller's drain, so it arrives again through the
+        queue — nothing is buried behind garbage."""
+        if not self._local_dir:
+            return False
+        try:
+            for name in (_SNAP_NAME, _SNAP_NAME + ".bak"):
+                src = os.path.join(self._local_dir, name)
+                if not os.path.exists(src):
+                    continue
+                tmp = os.path.join(self.dir, name + ".tmp")
+                shutil.copyfile(src, tmp)
+                os.replace(tmp, os.path.join(self.dir, name))
+            try:
+                with open(
+                    os.path.join(self._local_dir, _LOG_NAME), "rb"
+                ) as f:
+                    blob = f.read()
+            except OSError:
+                blob = b""
+            if not blob.startswith(MAGIC):
+                blob = MAGIC
+            good = len(MAGIC)
+            for _seq, _rec, frame in _iter_frames(blob):
+                good += len(frame)
+            tmp_log = self._log_path + ".tmp"
+            with open(tmp_log, "wb") as f:
+                f.write(blob[:good])
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                self._fh.close()
+            except (OSError, ValueError):
+                pass
+            os.replace(tmp_log, self._log_path)
+            self._fh = open(self._log_path, "ab")
+        except OSError:
+            logger.exception("journal mirror resync failed")
+            return False
+        logger.warning(
+            "journal mirror %s resynced from local journal %s",
+            self.dir, self._local_dir,
+        )
+        return True
+
+    def _rotate(self, doc: bytes, tail: bytes):
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(doc)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(self._snap_path):
+            try:
+                os.replace(self._snap_path, self._snap_path + ".bak")
+            except OSError:
+                pass
+        os.replace(tmp, self._snap_path)
+        tmp_log = self._log_path + ".tmp"
+        with open(tmp_log, "wb") as f:
+            f.write(MAGIC + tail)
+            f.flush()
+            os.fsync(f.fileno())
+        self._fh.close()
+        os.replace(tmp_log, self._log_path)
+        self._fh = open(self._log_path, "ab")
+
+    def flush(self, timeout: float = 5.0):
+        """Synchronous drain (shutdown path): everything enqueued so
+        far is fsync'd on the mirror when this returns (or the timeout
+        hit).  Waits out the in-flight batch too — the drain moves
+        tasks off the queue before the write, so an empty queue alone
+        does not mean the bytes landed."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._tasks and not self._inflight:
+                    return
+                self._wake = True
+                self._cv.notify()
+            time.sleep(0.01)
+
+    def close(self):
+        self.flush()
+        with self._cv:
+            self._stopped = True
+            self._wake = True
+            self._cv.notify()
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # a wedged storage fsync: closing the handle under the
+            # writer would turn a stall into a ValueError — leave it
+            # to the daemon reaper
+            return
+        try:
+            self._fh.close()
+        except (OSError, ValueError):
+            pass
+
+
 class StateJournal:
     """Writer half: fsync'd appends + snapshot/log rotation.
 
     Opening an existing directory first replays it (the result is kept
     on ``self.recovered`` for the caller's restore path) and truncates
-    any torn tail so subsequent appends extend a clean prefix."""
+    any torn tail so subsequent appends extend a clean prefix.
+
+    ``mirror_dir`` (or ``DLROVER_MASTER_JOURNAL_MIRROR_DIR``) arms the
+    async group-commit mirror; an empty local dir is seeded from the
+    mirror first — the different-host respawn path."""
 
     def __init__(
         self,
         journal_dir: str,
         fsync: bool = True,
         snapshot_every: int = 512,
+        mirror_dir: Optional[str] = None,
+        mirror_interval_s: Optional[float] = None,
     ):
         self.dir = journal_dir
         self._fsync = fsync
         self.snapshot_every = max(1, snapshot_every)
         os.makedirs(journal_dir, exist_ok=True)
+        if mirror_dir is None:
+            mirror_dir = os.getenv(JOURNAL_MIRROR_DIR_ENV, "")
+        self.mirror: Optional[_JournalMirror] = None
+        self.seeded_from_mirror = False
+        if mirror_dir:
+            self.seeded_from_mirror = seed_journal_from_mirror(
+                journal_dir, mirror_dir
+            )
+            if mirror_interval_s is None:
+                try:
+                    mirror_interval_s = float(os.getenv(
+                        JOURNAL_MIRROR_INTERVAL_ENV, "0.25"
+                    ))
+                except ValueError:
+                    mirror_interval_s = 0.25
+            self.mirror = _JournalMirror(
+                mirror_dir,
+                interval_s=mirror_interval_s,
+                local_dir=journal_dir,
+            )
         self._log_path = os.path.join(journal_dir, _LOG_NAME)
         self._snap_path = os.path.join(journal_dir, _SNAP_NAME)
         self.recovered = replay_dir(journal_dir)
@@ -234,6 +601,17 @@ class StateJournal:
             self._fh.truncate(self.recovered.good_offset)
             self._fh.seek(0, os.SEEK_END)
             self._flush()
+        if (
+            self.mirror is not None
+            and not self.seeded_from_mirror
+            and self.recovered.has_state
+        ):
+            # the local dir has history the mirror may never have
+            # seen (first arming over an existing journal, or a
+            # previous incarnation's flush failure): rebuild the
+            # mirror as a full copy before new appends extend it, or
+            # a different-host restore would replay a gapped log
+            self.mirror.request_resync()
 
     def _flush(self):
         self._fh.flush()
@@ -256,9 +634,14 @@ class StateJournal:
                 {"s": seq, "k": kind, "d": data}, default=str
             ).encode("utf-8")
             crc = zlib.crc32(payload) & 0xFFFFFFFF
-            self._fh.write(_REC.pack(len(payload), crc) + payload)
+            frame = _REC.pack(len(payload), crc) + payload
+            self._fh.write(frame)
             self._flush()
             self.entries_since_snapshot += 1
+            if self.mirror is not None:
+                # enqueue only — the mirror thread group-commits; the
+                # hot path never waits on the storage tier
+                self.mirror.enqueue_append(frame)
         _FSYNC_SECONDS.observe(time.monotonic() - t0)
         _ENTRIES_TOTAL.inc(kind=kind)
         return seq
@@ -325,6 +708,11 @@ class StateJournal:
             self._fsync_dir()
             self._fh = open(self._log_path, "ab")
             self.entries_since_snapshot = tail_count
+            if self.mirror is not None:
+                # the rotation rides the ordered mirror queue, so any
+                # append enqueued before it lands first and anything
+                # after it extends the rotated mirror log
+                self.mirror.enqueue_snapshot(doc, tail)
         _SNAPSHOTS_TOTAL.inc()
 
     def _fsync_dir(self):
@@ -338,6 +726,10 @@ class StateJournal:
             pass
 
     def close(self):
+        if self.mirror is not None:
+            # drain pending group commits so a graceful stop leaves
+            # the mirror byte-equal to the local log
+            self.mirror.close()
         with self._io_lock:
             try:
                 self._fh.close()

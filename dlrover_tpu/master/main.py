@@ -11,7 +11,10 @@ import sys
 
 from dlrover_tpu.common.constants import DefaultPorts
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.master.journal import JOURNAL_DIR_ENV
+from dlrover_tpu.master.journal import (
+    JOURNAL_DIR_ENV,
+    JOURNAL_MIRROR_DIR_ENV,
+)
 from dlrover_tpu.master.master import JobMaster
 
 
@@ -44,6 +47,15 @@ def parse_args(argv=None):
         help="crash-recovery state journal directory; a respawned "
         "master pointed at the same directory replays it and resumes "
         f"the job (also via {JOURNAL_DIR_ENV})",
+    )
+    parser.add_argument(
+        "--journal_mirror_dir",
+        type=str,
+        default=os.getenv(JOURNAL_MIRROR_DIR_ENV, ""),
+        help="async group-commit journal replica on the checkpoint "
+        "storage tier; a master respawned on a DIFFERENT host with a "
+        "fresh --journal_dir seeds it from this mirror (also via "
+        f"{JOURNAL_MIRROR_DIR_ENV})",
     )
     return parser.parse_args(argv)
 
@@ -116,6 +128,10 @@ def create_master(args) -> JobMaster:
 
 
 def run(args) -> int:
+    if args.journal_mirror_dir:
+        # the journal reads the mirror dir from env at construction;
+        # exporting the flag covers every platform's create path
+        os.environ[JOURNAL_MIRROR_DIR_ENV] = args.journal_mirror_dir
     master = create_master(args)
 
     def _graceful_exit(signum, _frame):

@@ -180,13 +180,20 @@ class JobMaster:
                     incarnation=self.incarnation,
                     recoveries=self.recoveries,
                     rdzv_round=self.elastic_rdzv.current_round(),
+                    # a fresh local dir seeded from the storage-tier
+                    # mirror = the different-host respawn path; the
+                    # chaos invariant reads this field
+                    from_mirror=self.journal.seeded_from_mirror,
                     **stats,
                 )
                 logger.warning(
-                    "master recovered from journal %s: %s entries "
+                    "master recovered from journal %s%s: %s entries "
                     "(%s re-queued shard leases), rdzv round %s, "
                     "recovery #%s",
-                    jdir, stats["entries"], stats["requeued"],
+                    jdir,
+                    " (seeded from mirror)"
+                    if self.journal.seeded_from_mirror else "",
+                    stats["entries"], stats["requeued"],
                     self.elastic_rdzv.current_round(),
                     self.recoveries,
                 )

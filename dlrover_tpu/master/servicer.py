@@ -188,6 +188,26 @@ class MasterServicer(RequestHandler):
                     message.last_step
                 )
             self._speed_monitor.add_running_worker(message.node_id)
+            # close any lease this worker already acked that the
+            # recovered master still holds open — the journal
+            # mirror's group-commit lag can lose the dead master's
+            # final acks on a different-host respawn, and without
+            # this the shard would re-dispatch (duplicate work).
+            # Several acks can land inside ONE commit window, so the
+            # whole recent-ack history reconciles, not just the last
+            acked = [
+                (str(pair[0]), int(pair[1]))
+                for pair in (message.recent_acked_tasks or [])
+            ]
+            last = (
+                message.last_acked_dataset, message.last_acked_task
+            )
+            if message.last_acked_task >= 0 and last not in acked:
+                acked.append(last)  # older agent: single-slot resync
+            for dataset_name, task_id in acked:
+                self._task_manager.reconcile_acked_task(
+                    dataset_name, task_id
+                )
             emit_event(
                 "agent_resync",
                 node_id=message.node_id,

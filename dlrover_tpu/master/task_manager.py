@@ -265,6 +265,28 @@ class BatchDatasetManager:
         self.todo[:0] = [self.doing.pop(tid).task for tid in stale]
         return len(stale)
 
+    def reconcile_acked(self, task_id: int) -> bool:
+        """A surviving worker reports (at session resync) that it
+        already acked ``task_id``, but this master does not hold it as
+        done — the journal MIRROR's group-commit lag can lose the last
+        window of acks on a different-host respawn.  Complete the task
+        now, whether the recovered state holds it as an in-flight
+        lease or (already re-queued) back in todo; the deterministic
+        splitter keeps task ids stable across replays, so the id is a
+        safe key.  Returns whether anything changed."""
+        doing = self.doing.pop(task_id, None)
+        if doing is not None:
+            self._completed_count += 1
+            self.last_ack_time = time.time()
+            return True
+        for i, t in enumerate(self.todo):
+            if t.task_id == task_id:
+                self.todo.pop(i)
+                self._completed_count += 1
+                self.last_ack_time = time.time()
+                return True
+        return False
+
 
 class StreamingDatasetManager(BatchDatasetManager):
     """Unbounded-stream dispatch (reference
@@ -551,12 +573,47 @@ class TaskManager:
                         bool(data.get("success", True)),
                     )
             return True
+        if kind == "ack_reconciled":
+            with self._lock:
+                ds = self._datasets.get(data.get("dataset", ""))
+                if ds is not None:
+                    ds.reconcile_acked(int(data["task_id"]))
+            return True
         if kind == "ds_restore":
             self.restore_dataset_from_checkpoint(
                 data.get("dataset", ""), data.get("content", "")
             )
             return True
         return False
+
+    def reconcile_acked_task(
+        self, dataset_name: str, task_id: int
+    ) -> bool:
+        """Session-resync reconciliation: the worker's reported last
+        ack closes any lease the recovered master still holds open —
+        the guard that keeps exactly-once sharding true when the
+        journal MIRROR's group-commit lag dropped the final acks of a
+        dead master (different-host respawn).  Journaled under its own
+        kind so a later replay re-applies the completion without
+        fabricating a ``shard_ack`` event (the original ack is already
+        in the event log)."""
+        if task_id < 0 or not dataset_name:
+            return False
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return False
+            changed = ds.reconcile_acked(task_id)
+            if changed:
+                self._jot(
+                    "ack_reconciled",
+                    {"dataset": dataset_name, "task_id": task_id},
+                )
+                logger.warning(
+                    "resync reconciled lost ack: dataset %s task %s "
+                    "(journal mirror lag)", dataset_name, task_id,
+                )
+            return changed
 
     def requeue_unacked(self) -> int:
         """Recovery epilogue: return every un-acked lease to the

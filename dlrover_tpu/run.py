@@ -36,6 +36,13 @@ from dlrover_tpu.telemetry.events import emit_event
 # (each respawn replays the state journal and resumes the job)
 MASTER_MAX_RESTARTS_ENV = "DLROVER_MASTER_MAX_RESTARTS"
 
+# respawn the master with a FRESH journal dir instead of the dead
+# incarnation's: recovery must then come entirely from the
+# storage-tier mirror (DLROVER_MASTER_JOURNAL_MIRROR_DIR) — the
+# different-host respawn path, exercised by the chaos scenario
+# ``master_respawn_other_host``
+MASTER_FRESH_JOURNAL_ENV = "DLROVER_MASTER_RESPAWN_FRESH_JOURNAL"
+
 
 def parse_nnodes(value: str) -> Tuple[int, int]:
     if ":" in value:
@@ -150,6 +157,7 @@ class _MasterSupervisor:
         self._min_nodes = min_nodes
         self._node_unit = node_unit
         self._journal_dir = journal_dir
+        self._fresh_journal_dirs: List[str] = []
         self._max_restarts = int(
             os.environ.get(MASTER_MAX_RESTARTS_ENV, "3") or 3
         )
@@ -189,11 +197,28 @@ class _MasterSupervisor:
                 # the job is shutting down: a respawn now would leak
                 # a master nobody will ever terminate
                 return
+            journal_dir = self._journal_dir
+            if os.environ.get(
+                MASTER_FRESH_JOURNAL_ENV, ""
+            ).strip().lower() in ("1", "true", "yes", "on"):
+                # host-portability drill: the respawn gets an EMPTY
+                # journal dir (as a replacement host would), so the
+                # only path back to the job's state is seeding from
+                # the storage-tier mirror
+                journal_dir = tempfile.mkdtemp(
+                    prefix="dlrover_mjournal_fresh_"
+                )
+                self._fresh_journal_dirs.append(journal_dir)
+                logger.warning(
+                    "respawning master with a FRESH journal dir %s "
+                    "(recovery must seed from the mirror)",
+                    journal_dir,
+                )
             try:
                 self.proc, _ = _launch_local_master(
                     self._max_nodes,
                     port=self._port,
-                    journal_dir=self._journal_dir,
+                    journal_dir=journal_dir,
                     restart_count=self.restarts,
                     min_nodes=self._min_nodes,
                     node_unit=self._node_unit,
@@ -215,6 +240,10 @@ class _MasterSupervisor:
             self.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             self.proc.kill()
+        for d in self._fresh_journal_dirs:
+            import shutil
+
+            shutil.rmtree(d, ignore_errors=True)
 
 
 def apply_auto_config(args):

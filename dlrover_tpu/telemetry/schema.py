@@ -66,7 +66,8 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         ]),
         _s("master_recovered",
            ["job", "incarnation", "recoveries", "rdzv_round"],
-           ["entries", "applied", "requeued", "snapshot", "truncated"]),
+           ["entries", "applied", "requeued", "snapshot", "truncated",
+            "from_mirror"]),
         _s("master_respawn", ["port", "respawn", "rc"]),
         _s("journal_replay", [
             "dir", "entries", "snapshot_seq", "last_seq", "truncated",
@@ -126,6 +127,23 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         # while the replacement trainer was importing
         _s("shm_prefetch", ["bytes", "seconds"],
            ["segments", "restart_count"]),
+        # measured death->first-step budget, one event per phase
+        # (spawn / import / restore / retrace / first_step) — the
+        # trainer-side RecoveryProfiler emits them and the timeline
+        # derives the recovery breakdown slices
+        _s("recovery_phase", ["phase", "seconds", "restart_count"],
+           ["node_rank"]),
+        # persistent-compile-cache witness around the first
+        # post-restore step: hit = no new cache entries over a warm
+        # dir (the retrace-elimination invariant's raw material)
+        _s("compile_cache", ["hit", "restart_count"],
+           ["entries_before", "entries_after", "retrace_s", "dir",
+            "node_rank"]),
+        # master journal mirrored to the checkpoint storage tier
+        # (async group commit): how far the mirror lagged when a
+        # batch flushed — the host-portable control plane's witness
+        _s("journal_mirror_flush", ["records", "lag_s"],
+           ["dir"]),
         _s("warm_fork_fallback", [
             "node_rank", "local_rank", "restart_count", "reason",
         ]),

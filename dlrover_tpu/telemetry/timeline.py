@@ -89,6 +89,17 @@ CAT_RESTART = "restart"
 CAT_CHECKPOINT = "checkpoint"
 CAT_SHARD = "shard_lease"
 CAT_STEP = "train_step"
+# the measured death->first-step budget from the trainer-side
+# RecoveryProfiler: per-phase sub-slices of a restart window.  A
+# DISPLAY category, deliberately outside CAUSE_PRIORITY: the same
+# seconds are already claimed by the restart/restore/rendezvous
+# buckets, and attributing them again would double-book the loss.
+CAT_RECOVERY_PHASE = "recovery_phase"
+# phase order of one recovery budget (mirrors
+# dlrover_recovery_phase_seconds{phase})
+RECOVERY_PHASES = (
+    "spawn", "import", "restore", "retrace", "first_step",
+)
 
 # how long after master_recovered a session resync still counts as
 # part of the same recovery (parked clients trickle back)
@@ -164,8 +175,26 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
             continue
         if etype in ("chaos_inject", "loss_spike",
                      "diagnosis_verdict", "hang_evidence",
-                     "rpc_slo_breach"):
+                     "rpc_slo_breach", "compile_cache"):
             tl.instants.append(e)
+            continue
+        if etype == "recovery_phase":
+            # emitted at phase END with the measured duration: the
+            # recovery-breakdown slice set under the restart window
+            secs = _num(e.get("seconds"))
+            tl.slices.append(Slice(
+                name=(
+                    f"recovery[{e.get('phase')}] "
+                    f"#{e.get('restart_count')}"
+                ),
+                cat=CAT_RECOVERY_PHASE,
+                start=ts - secs, end=ts, track=track,
+                meta={
+                    "phase": e.get("phase"),
+                    "restart_count": e.get("restart_count"),
+                    "node_rank": e.get("node_rank"),
+                },
+            ))
             continue
         if etype == "span":
             name = str(e.get("name", ""))
@@ -415,6 +444,38 @@ def _assemble_resizes(ev: List[Dict], tl: JobTimeline):
                 },
             ))
             start = end
+
+
+def recovery_budgets(
+    events: Iterable[Dict],
+) -> Dict[Tuple[int, int], Dict]:
+    """Per-incarnation recovery budget from the raw event stream:
+    ``{(node_rank, restart_count): {phase: seconds, ...,
+    "compile_cache_hit": bool?, "retrace_s": float?}}`` — the single
+    ingestion path the incident report, bench.py and the chaos
+    cache-hit invariants all read, so they can never disagree about
+    what was measured."""
+    out: Dict[Tuple[int, int], Dict] = {}
+    for e in events:
+        etype = e.get("type")
+        if etype == "recovery_phase":
+            key = (
+                int(_num(e.get("node_rank"), -1)),
+                int(_num(e.get("restart_count"), -1)),
+            )
+            out.setdefault(key, {})[str(e.get("phase"))] = _num(
+                e.get("seconds")
+            )
+        elif etype == "compile_cache":
+            key = (
+                int(_num(e.get("node_rank"), -1)),
+                int(_num(e.get("restart_count"), -1)),
+            )
+            rec = out.setdefault(key, {})
+            rec["compile_cache_hit"] = bool(e.get("hit"))
+            if e.get("retrace_s") is not None:
+                rec["retrace_s"] = _num(e.get("retrace_s"))
+    return out
 
 
 def _assemble_shard_leases(ev: List[Dict], tl: JobTimeline):
@@ -688,6 +749,14 @@ def _describe_instant(e: Dict) -> str:
             f"{_num(e.get('observed_s')):.3f}s > "
             f"{_num(e.get('threshold_s')):.3f}s"
         )
+    if etype == "compile_cache":
+        return (
+            f"{'HIT' if e.get('hit') else 'MISS'} "
+            f"restart#{e.get('restart_count')} "
+            f"retrace={_num(e.get('retrace_s')):.3f}s "
+            f"entries {e.get('entries_before')}->"
+            f"{e.get('entries_after')}"
+        )
     return f"step={e.get('step')}"
 
 
@@ -797,6 +866,29 @@ def to_report(
     for cause, seconds in attribution["buckets"].items():
         pct = (100.0 * seconds / loss) if loss > 0 else 0.0
         lines.append(f"  {cause:<16} {seconds:8.3f}s  {pct:5.1f}%")
+    budgets = recovery_budgets(tl.events)
+    if budgets:
+        lines.append(
+            "recovery budgets (death->first-step, per restart):"
+        )
+        for (rank, count), phases in sorted(budgets.items()):
+            total = sum(
+                v for k, v in phases.items()
+                if k in RECOVERY_PHASES
+            )
+            parts = "  ".join(
+                f"{p}={phases[p]:.3f}s" for p in RECOVERY_PHASES
+                if p in phases
+            )
+            cache = phases.get("compile_cache_hit")
+            cache_txt = (
+                "  cache=HIT" if cache is True
+                else "  cache=MISS" if cache is False else ""
+            )
+            lines.append(
+                f"  node{rank} restart#{count}: {total:.3f}s  "
+                f"({parts}){cache_txt}"
+            )
     slo_breaches = [
         e for e in tl.instants if e.get("type") == "rpc_slo_breach"
     ]

@@ -1,0 +1,221 @@
+"""Recovery-phase profiler: the death→first-step budget, measured.
+
+The invisible-recovery target (``elastic_recovery_s ≤ 2.0``) is only
+reachable — and only *provable* — with the serial chain broken into
+named phases, each measured where it actually runs:
+
+- **spawn**: the agent witnesses the death → this process exists
+  (kernel start time from ``/proc/self/stat``, so the measurement
+  covers the fork/exec itself, not just userland);
+- **import**: process start → the trainer constructed this profiler
+  (interpreter + jax/flax imports — near zero under a warm fork);
+- **restore**: the checkpoint restore (the engine's measured
+  ``total_s``);
+- **retrace**: the first post-restore step's trace+compile, with the
+  persistent compilation cache's hit/miss witnessed from the cache
+  directory (:mod:`dlrover_tpu.common.compile_cache`);
+- **first_step**: the remainder until the first step completes.
+
+Each phase lands as a ``recovery_phase`` event + a
+``dlrover_recovery_phase_seconds{phase}`` histogram sample, so the
+chaos invariants, the timeline's recovery breakdown and bench.py all
+read the same numbers.  The agent exports ``DLROVER_RECOVERY_T0``
+(the wall clock at which it observed the death) into every respawned
+worker's env; without it the profiler still measures import/restore/
+retrace relative to process start (a first incarnation, or a cold
+launch).
+"""
+
+import os
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common import env_utils
+from dlrover_tpu.common.compile_cache import (
+    cache_entries,
+    enable_persistent_cache,
+    job_cache_dir,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.telemetry.metrics import get_registry
+
+RECOVERY_T0_ENV = "DLROVER_RECOVERY_T0"
+
+_REG = get_registry()
+_PHASE_SECONDS = _REG.histogram(
+    "dlrover_recovery_phase_seconds",
+    "Measured death->first-step recovery budget by phase "
+    "(spawn / import / restore / retrace / first_step)",
+)
+
+
+def _proc_start_epoch() -> Optional[float]:
+    """Absolute wall-clock time this process started: kernel start
+    ticks (``/proc/self/stat`` field 22) against the boot epoch from
+    ``/proc/uptime`` — survives exec, unlike any userland timestamp."""
+    fields = env_utils.proc_stat_fields(os.getpid())
+    if fields is None:
+        return None
+    try:
+        ticks = int(fields[19])
+        hz = float(os.sysconf("SC_CLK_TCK"))
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+        boot_epoch = time.time() - uptime
+        return boot_epoch + ticks / hz
+    except (IndexError, ValueError, OSError):
+        return None
+
+
+class _Phase:
+    __slots__ = ("_profiler", "_name", "_t0")
+
+    def __init__(self, profiler: "RecoveryProfiler", name: str):
+        self._profiler = profiler
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._profiler.record(
+            self._name, time.perf_counter() - self._t0
+        )
+        return False
+
+
+class RecoveryProfiler:
+    """Construct RIGHT AFTER the heavy imports; the constructor books
+    the spawn and import phases and activates the job's persistent
+    compile cache in-process (covering entrypoints whose jax imported
+    before the agent's env reached them)."""
+
+    def __init__(
+        self,
+        restart_count: Optional[int] = None,
+        node_rank: Optional[int] = None,
+    ):
+        self.restart_count = (
+            restart_count if restart_count is not None
+            else env_utils.get_restart_count()
+        )
+        self.node_rank = (
+            node_rank if node_rank is not None
+            else env_utils.get_node_rank()
+        )
+        self.phases: Dict[str, float] = {}
+        self.cache_hit: Optional[bool] = None
+        self.cache_dir = enable_persistent_cache() or job_cache_dir()
+        try:
+            self.t0 = float(os.getenv(RECOVERY_T0_ENV, "") or 0.0)
+        except ValueError:
+            self.t0 = 0.0
+        now = time.time()
+        start = _proc_start_epoch()
+        self._proc_start = start if start is not None else now
+        if self.t0 > 0 and self._proc_start >= self.t0:
+            self.record("spawn", self._proc_start - self.t0)
+        self.record("import", max(0.0, now - self._proc_start))
+        self._first_step_t0 = time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, phase: str, seconds: float):
+        seconds = max(0.0, float(seconds))
+        self.phases[phase] = round(seconds, 4)
+        _PHASE_SECONDS.observe(seconds, phase=phase)
+        emit_event(
+            "recovery_phase",
+            phase=phase,
+            seconds=round(seconds, 4),
+            restart_count=self.restart_count,
+            node_rank=self.node_rank,
+        )
+
+    def phase(self, name: str) -> _Phase:
+        """``with profiler.phase("restore"): step, state = load()``"""
+        return _Phase(self, name)
+
+    def record_restore(self, restore_phases: Dict) -> None:
+        """Book the restore phase from the engine's measured
+        breakdown (``Checkpointer.last_restore_phases``)."""
+        total = restore_phases.get("total_s")
+        if isinstance(total, (int, float)) and total > 0:
+            self.record("restore", float(total))
+
+    def measured_retrace(self) -> "_Retrace":
+        """Bracket the FIRST post-restore step::
+
+            with profiler.measured_retrace() as r:
+                state, metrics = step_fn(state, batch)
+                r.block(metrics)
+
+        The block's wall time is the retrace phase; the cache
+        directory's entry count before/after witnesses the compile-
+        cache hit (no new ``*-cache`` entries over a warm dir = HIT),
+        emitted as a ``compile_cache`` event.  ``block`` brackets
+        ``block_until_ready`` so async dispatch cannot shrink the
+        measurement."""
+        return _Retrace(self)
+
+    def record_first_step(self):
+        """Close the budget: remainder since the last recorded phase
+        boundary (profiler construction → now, minus restore+retrace,
+        which were measured inside it)."""
+        elapsed = time.perf_counter() - self._first_step_t0
+        inner = sum(
+            self.phases.get(p, 0.0) for p in ("restore", "retrace")
+        )
+        self.record("first_step", max(0.0, elapsed - inner))
+        if self.t0 > 0:
+            total = time.time() - self.t0
+            logger.info(
+                "recovery budget (restart %s): %.2fs total — %s",
+                self.restart_count, total, self.phases,
+            )
+
+
+class _Retrace:
+    def __init__(self, profiler: RecoveryProfiler):
+        self._p = profiler
+        self._blocked = None
+
+    def block(self, x):
+        """Remember the step's output so ``__exit__`` can wait on it
+        (retrace_s must include the compile's execution barrier)."""
+        self._blocked = x
+        return x
+
+    def __enter__(self):
+        self._before = cache_entries(self._p.cache_dir)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None:
+            return False
+        if self._blocked is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._blocked)
+            except Exception:  # noqa: BLE001 - non-jax outputs
+                pass
+        retrace_s = time.perf_counter() - self._t0
+        after = cache_entries(self._p.cache_dir)
+        hit = self._before > 0 and after <= self._before
+        self._p.cache_hit = hit
+        self._p.record("retrace", retrace_s)
+        emit_event(
+            "compile_cache",
+            hit=hit,
+            entries_before=self._before,
+            entries_after=after,
+            retrace_s=round(retrace_s, 4),
+            dir=self._p.cache_dir,
+            restart_count=self._p.restart_count,
+            node_rank=self._p.node_rank,
+        )
+        return False

@@ -48,6 +48,13 @@ def _fat_snapshot() -> dict:
         "xl_train_step": {"mfu": 0.391234},
         "flash_ckpt": {
             "flash_stall_s": 0.012345, "restore_shm_s": 3.971234,
+            # the ISSUE-10 breakdown keys must flatten to compact
+            # scalar strings in the headline
+            "restore_shm_phases": {
+                "read_s": 0.123456, "assemble_s": 3.456789,
+                "h2d_s": 0.345678, "bytes": 402653184, "workers": 8,
+            },
+            "memcpy_baseline_MBps": 1234.567,
         },
         "auto_config": {"searched_vs_hand": 0.9661234},
         "sparse_kv": {
@@ -56,11 +63,26 @@ def _fat_snapshot() -> dict:
                 "pipeline_speedup": 2.212345,
             },
             "host_gather_Mlookups_per_s": 16.312345,
+            "kv_checkpoint": {
+                "export_s": 0.123456, "restore_s": 0.234567,
+            },
         },
         "input_pipeline": {"input_bound_pct": 12.345678},
         "gqa_attention_kernel": {"seq2048": {"speedup": 1.812345}},
         "attention_kernel": {"seq8192": {"flash_vs_xla_speedup": 2.9}},
-        "elastic_recovery": {"recovery_s": 3.612345},
+        "elastic_recovery": {
+            "recovery_s": 3.612345,
+            "retrace_s": 1.103456,
+            "cache_hits": 1, "cache_misses": 0,
+            "cycles": {
+                "restart1": {
+                    "spawn": 0.147123, "import": 0.129456,
+                    "restore": 0.019789, "retrace": 1.103456,
+                    "first_step": 0.655123,
+                    "compile_cache_hit": True,
+                },
+            },
+        },
     }
     # every known section both errors and is skipped — the headline's
     # lists must survive the worst case

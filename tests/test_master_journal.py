@@ -529,3 +529,247 @@ def test_client_parks_and_resyncs_across_server_restart():
         t.join()
         client.close()
         s2_holder["s"].stop()
+
+
+# -- journal mirror: host-portable control plane (ISSUE 10) -------------
+
+
+def test_mirror_matches_local_after_flush(tmp_path):
+    """Appends + a snapshot rotation group-commit to the mirror; a
+    graceful close drains the queue, after which replaying the mirror
+    yields exactly the local journal's state."""
+    local, mirror = str(tmp_path / "local"), str(tmp_path / "mirror")
+    j = StateJournal(local, mirror_dir=mirror, mirror_interval_s=0.02)
+    for i in range(30):
+        j.append("k", {"i": i})
+    j.snapshot({"base": True}, seq=20)
+    for i in range(30, 34):
+        j.append("k", {"i": i})
+    j.close()
+    a, b = replay_dir(local), replay_dir(mirror)
+    assert a.snapshot == b.snapshot
+    assert a.snapshot_seq == b.snapshot_seq == 20
+    assert a.entries == b.entries
+    assert a.last_seq == b.last_seq == 34
+
+
+def test_mirror_lag_bounded_by_group_commit_window(tmp_path):
+    """The journal_mirror_flush events stamp how old the oldest
+    un-flushed record was at each group commit — bounded by the
+    configured window plus scheduling jitter, never unbounded."""
+    os.environ[EVENT_LOG_ENV] = str(tmp_path / "events.jsonl")
+    try:
+        local = str(tmp_path / "local")
+        mirror = str(tmp_path / "mirror")
+        interval = 0.05
+        j = StateJournal(
+            local, mirror_dir=mirror, mirror_interval_s=interval
+        )
+        for i in range(50):
+            j.append("k", {"i": i})
+            time.sleep(0.005)
+        j.close()
+        flushes = [
+            e for e in read_events(str(tmp_path / "events.jsonl"))
+            if e.get("type") == "journal_mirror_flush"
+        ]
+        assert flushes, "no group commits recorded"
+        assert sum(e["records"] for e in flushes) == 50
+        # lag ≤ window + generous scheduling slack (CI boxes stall)
+        assert max(e["lag_s"] for e in flushes) < interval + 2.0
+        # group commit actually batched: fewer flushes than appends
+        assert len(flushes) < 50
+    finally:
+        os.environ.pop(EVENT_LOG_ENV, None)
+
+
+def test_restore_from_mirror_equals_restore_from_local(tmp_path):
+    """A FRESH journal dir pointed at the mirror seeds itself and
+    replays the same state the dead master's local dir would have —
+    the different-host respawn path."""
+    local, mirror = str(tmp_path / "local"), str(tmp_path / "mirror")
+    j = StateJournal(local, mirror_dir=mirror, mirror_interval_s=0.02)
+    for i in range(12):
+        j.append("dispatch", {"task_id": i})
+    j.snapshot({"tasks": 12}, seq=6)
+    j.append("ack", {"task_id": 0})
+    j.close()
+    fresh = str(tmp_path / "fresh")
+    j2 = StateJournal(fresh, mirror_dir=mirror)
+    assert j2.seeded_from_mirror
+    local_replay = replay_dir(local)
+    assert j2.recovered.snapshot == local_replay.snapshot
+    assert j2.recovered.entries == local_replay.entries
+    assert j2.recovered.last_seq == local_replay.last_seq == 13
+    # the seeded journal keeps appending into BOTH logs
+    j2.append("ack", {"task_id": 1})
+    j2.close()
+    assert replay_dir(mirror).last_seq == 14
+    # a local dir WITH state wins over the mirror (same-host respawn:
+    # the local log is fresher than the lagging mirror)
+    j3 = StateJournal(local, mirror_dir=mirror)
+    assert not j3.seeded_from_mirror
+    j3.close()
+
+
+def test_torn_mirror_tail_replays_prefix_consistent(tmp_path):
+    """A mirror whose last group commit was torn mid-frame (the
+    master died mid-write) seeds a fresh dir with the valid prefix —
+    and the next incarnation's appends extend a CLEAN mirror log
+    instead of burying records after garbage."""
+    local, mirror = str(tmp_path / "local"), str(tmp_path / "mirror")
+    j = StateJournal(local, mirror_dir=mirror, mirror_interval_s=0.02)
+    for i in range(10):
+        j.append("k", {"i": i})
+    j.close()
+    log = os.path.join(mirror, "journal.log")
+    size = os.path.getsize(log)
+    with open(log, "r+b") as f:
+        f.truncate(size - 5)  # tear the final frame
+    fresh = str(tmp_path / "fresh")
+    j2 = StateJournal(fresh, mirror_dir=mirror)
+    assert j2.seeded_from_mirror
+    assert j2.recovered.last_seq == 9  # record 10 torn away
+    assert j2.recovered.truncated
+    j2.append("k", {"i": "post-tear"})
+    j2.close()
+    m = replay_dir(mirror)
+    assert not m.truncated  # the torn tail was cut before appending
+    assert m.last_seq == 10
+    assert m.entries[-1][2] == {"i": "post-tear"}
+
+
+def test_arming_mirror_over_existing_journal_resyncs(tmp_path):
+    """Pointing a mirror at a journal dir that ALREADY has history
+    must replicate that history, not just new appends — otherwise the
+    mirror looks seed-eligible (has_state) while missing the records
+    every later entry depends on."""
+    local, mirror = str(tmp_path / "local"), str(tmp_path / "mirror")
+    j = StateJournal(local)  # no mirror yet
+    for i in range(8):
+        j.append("k", {"i": i})
+    j.close()
+    j2 = StateJournal(local, mirror_dir=mirror, mirror_interval_s=0.02)
+    j2.append("k", {"i": "after-arming"})
+    j2.close()
+    a, b = replay_dir(local), replay_dir(mirror)
+    assert b.last_seq == a.last_seq == 9
+    assert b.entries == a.entries  # pre-arming history included
+
+
+def test_failed_mirror_flush_resyncs_without_seq_gap(tmp_path):
+    """A flush that dies mid-write (broken handle / browned-out tier)
+    must not leave a sequence HOLE in the mirror: the mirror resyncs
+    from the local journal and stays a consistent prefix."""
+    local, mirror = str(tmp_path / "local"), str(tmp_path / "mirror")
+    j = StateJournal(local, mirror_dir=mirror, mirror_interval_s=0.02)
+    for i in range(5):
+        j.append("k", {"i": i})
+    j.mirror.flush()
+    # sabotage the mirror's handle: the next group commit raises
+    # ValueError (closed file), which must schedule a resync — not
+    # kill the thread, not skip the batch
+    j.mirror._fh.close()
+    for i in range(5, 12):
+        j.append("k", {"i": i})
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if replay_dir(mirror).last_seq >= 12:
+            break
+        time.sleep(0.05)
+    j.close()
+    a, b = replay_dir(local), replay_dir(mirror)
+    assert b.last_seq == a.last_seq == 12
+    # no gap: every seq present exactly once, in order
+    assert [s for s, _k, _d in b.entries] == list(range(1, 13))
+
+
+def test_mirror_env_defaults(tmp_path, monkeypatch):
+    """DLROVER_MASTER_JOURNAL_MIRROR_DIR arms the mirror without any
+    constructor plumbing (the JobMaster path)."""
+    mirror = str(tmp_path / "mirror")
+    monkeypatch.setenv(jmod.JOURNAL_MIRROR_DIR_ENV, mirror)
+    j = StateJournal(str(tmp_path / "local"))
+    assert j.mirror is not None and j.mirror.dir == mirror
+    j.append("k", {"x": 1})
+    j.close()
+    assert replay_dir(mirror).last_seq == 1
+
+
+def test_resync_reconciles_mirror_lagged_ack(tmp_path):
+    """Exactly-once under mirror lag: a worker's session resync
+    reporting an ack the recovered master never saw closes the lease
+    (doing OR already-requeued todo) instead of re-dispatching it."""
+    from dlrover_tpu.common.messages import DatasetShardParams
+    from dlrover_tpu.master.task_manager import TaskManager
+
+    tm = TaskManager()
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="ds", batch_size=1, dataset_size=4,
+        num_minibatches_per_shard=1, storage_type="table",
+    ))
+    t0 = tm.get_dataset_task(0, "ds")
+    assert t0.task_id >= 0
+    # lease open (mirror lost the ack): resync closes it
+    assert tm.reconcile_acked_task("ds", t0.task_id)
+    ds = tm._datasets["ds"]
+    assert t0.task_id not in ds.doing
+    assert ds.completed_count == 1
+    # requeued variant: dispatch, requeue (recovery epilogue ran
+    # before the resync arrived), then the late resync still lands
+    t1 = tm.get_dataset_task(0, "ds")
+    assert tm.requeue_unacked() == 1
+    assert tm.reconcile_acked_task("ds", t1.task_id)
+    assert ds.completed_count == 2
+    assert all(t.task_id != t1.task_id for t in ds.todo)
+    # unknown/negative ids are ignored
+    assert not tm.reconcile_acked_task("ds", 999)
+    assert not tm.reconcile_acked_task("", 1)
+
+
+def test_resync_reconciles_multiple_acks_in_one_window(tmp_path):
+    """Several acks can complete inside ONE mirror group-commit
+    window; the resync handshake ships the whole recent-ack history
+    and the servicer closes EVERY lease, not just the most recent —
+    otherwise the earlier shards re-dispatch and train twice."""
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.common.messages import DatasetShardParams
+    from dlrover_tpu.master.job_manager import JobManager
+    from dlrover_tpu.master.kv_store import KVStoreService
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+        NetworkCheckRendezvousManager,
+    )
+    from dlrover_tpu.master.servicer import MasterServicer
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.task_manager import TaskManager
+
+    tm = TaskManager()
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="ds", batch_size=1, dataset_size=4,
+        num_minibatches_per_shard=1, storage_type="table",
+    ))
+    t0 = tm.get_dataset_task(0, "ds")
+    t1 = tm.get_dataset_task(0, "ds")
+    jm = JobManager()
+    jm.add_node(NodeType.WORKER, 0)
+    servicer = MasterServicer(
+        task_manager=tm,
+        job_manager=jm,
+        rdzv_managers={
+            "elastic-training": ElasticTrainingRendezvousManager(),
+            "network-check": NetworkCheckRendezvousManager(),
+        },
+        kv_store=KVStoreService(),
+        speed_monitor=SpeedMonitor(),
+    )
+    resp = servicer.get(0, "worker", msg.SessionResyncRequest(
+        node_id=0,
+        last_acked_dataset="ds",
+        last_acked_task=t1.task_id,
+        recent_acked_tasks=[("ds", t0.task_id), ("ds", t1.task_id)],
+    ))
+    assert resp.success
+    ds = tm._datasets["ds"]
+    assert t0.task_id not in ds.doing and t1.task_id not in ds.doing
+    assert ds.completed_count == 2

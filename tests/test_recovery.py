@@ -1,0 +1,218 @@
+"""Invisible recovery (ISSUE 10) units: the job-keyed persistent
+compile cache, the trainer-side RecoveryProfiler (measured
+death->first-step budget + cache-hit witness), the timeline's
+recovery-breakdown slices, and the agent-side overlap knobs."""
+
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.common import compile_cache as cc
+from dlrover_tpu.telemetry import timeline as flight
+from dlrover_tpu.telemetry.events import EVENT_LOG_ENV, read_events
+from dlrover_tpu.trainer import recovery as rec
+
+
+@pytest.fixture()
+def event_log(tmp_path, monkeypatch):
+    path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv(EVENT_LOG_ENV, path)
+    return path
+
+
+# -- compile cache ------------------------------------------------------
+
+
+def test_job_cache_dir_resolution_order(tmp_path, monkeypatch):
+    monkeypatch.delenv(cc.DLROVER_CACHE_DIR_ENV, raising=False)
+    monkeypatch.delenv(cc.CACHE_DIR_ENV, raising=False)
+    monkeypatch.delenv("DLROVER_JOB_NAME", raising=False)
+    # 3) job-keyed default (namespace rule shared with shm segments)
+    default = cc.job_cache_dir()
+    assert "dlrover_jax_cache_" in default
+    # two jobs (different socket dirs) resolve different dirs; the
+    # same job resolves the same one (that IS the sharing contract)
+    monkeypatch.setenv("DLROVER_SHARED_DIR", str(tmp_path / "a"))
+    a1, a2 = cc.job_cache_dir(), cc.job_cache_dir()
+    monkeypatch.setenv("DLROVER_SHARED_DIR", str(tmp_path / "b"))
+    b = cc.job_cache_dir()
+    assert a1 == a2 and a1 != b
+    # 2) ambient JAX_COMPILATION_CACHE_DIR wins over the default
+    monkeypatch.setenv(cc.CACHE_DIR_ENV, "/ambient")
+    assert cc.job_cache_dir() == "/ambient"
+    # 1) the explicit operator knob wins over everything
+    monkeypatch.setenv(cc.DLROVER_CACHE_DIR_ENV, "/explicit")
+    assert cc.job_cache_dir() == "/explicit"
+
+
+def test_cache_env_and_entry_count(tmp_path, monkeypatch):
+    cache = tmp_path / "cache"
+    monkeypatch.setenv(cc.DLROVER_CACHE_DIR_ENV, str(cache))
+    env = cc.cache_env()
+    assert env[cc.CACHE_DIR_ENV] == str(cache)
+    assert env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] == "0"
+    # entry counting: only *-cache files are executables; the -atime
+    # siblings are hit markers
+    assert cc.cache_entries(str(cache)) == 0
+    cache.mkdir()
+    (cache / "jit_f-abc-cache").write_bytes(b"x")
+    (cache / "jit_f-abc-atime").write_bytes(b"")
+    (cache / "jit_g-def-cache").write_bytes(b"y")
+    assert cc.cache_entries(str(cache)) == 2
+
+
+# -- recovery profiler --------------------------------------------------
+
+
+def test_profiler_phases_and_events(tmp_path, monkeypatch, event_log):
+    monkeypatch.setenv(
+        cc.DLROVER_CACHE_DIR_ENV, str(tmp_path / "cache")
+    )
+    monkeypatch.setenv("DLROVER_RESTART_COUNT", "2")
+    monkeypatch.setenv("DLROVER_NODE_RANK", "0")
+    # T0 slightly in the past: the spawn phase is proc_start - t0
+    monkeypatch.setenv(
+        rec.RECOVERY_T0_ENV, f"{time.time() - 5.0:.6f}"
+    )
+    prof = rec.RecoveryProfiler()
+    assert prof.restart_count == 2
+    assert "import" in prof.phases
+    # spawn only books when the kernel start time resolves; on /proc
+    # platforms it must be ~the 5s offset
+    if "spawn" in prof.phases:
+        assert 0.0 <= prof.phases["spawn"] <= 60.0
+    prof.record_restore({"total_s": 0.25, "tier": "shm"})
+    assert prof.phases["restore"] == 0.25
+    with prof.phase("custom"):
+        time.sleep(0.01)
+    assert prof.phases["custom"] >= 0.01
+    prof.record_first_step()
+    assert prof.phases["first_step"] >= 0.0
+    types = [e["type"] for e in read_events(event_log)]
+    assert types.count("recovery_phase") >= 4
+
+
+def test_retrace_hit_vs_miss_witness(tmp_path, monkeypatch,
+                                     event_log):
+    """The cache-hit rule: no NEW *-cache entries across the bracket
+    over a WARM dir = HIT; new entries (or an empty dir) = MISS."""
+    cache = tmp_path / "cache"
+    monkeypatch.setenv(cc.DLROVER_CACHE_DIR_ENV, str(cache))
+    monkeypatch.setenv("DLROVER_RESTART_COUNT", "1")
+    prof = rec.RecoveryProfiler()
+
+    # cold dir: whatever happens, not a hit
+    with prof.measured_retrace():
+        (cache / "jit_f-1-cache").write_bytes(b"x")  # a compile
+    assert prof.cache_hit is False
+
+    # warm dir, no new entries: hit
+    prof2 = rec.RecoveryProfiler()
+    with prof2.measured_retrace():
+        pass
+    assert prof2.cache_hit is True
+
+    events = [
+        e for e in read_events(event_log)
+        if e["type"] == "compile_cache"
+    ]
+    assert [e["hit"] for e in events] == [False, True]
+    assert all("retrace_s" in e for e in events)
+    # and retrace landed in the phase dict both times
+    assert "retrace" in prof2.phases
+
+
+# -- timeline integration ----------------------------------------------
+
+
+def _mk_events():
+    t = 1000.0
+    return [
+        {"type": "recovery_phase", "ts": t + 1.0, "phase": "spawn",
+         "seconds": 0.2, "restart_count": 1, "node_rank": 0,
+         "source": "trainer"},
+        {"type": "recovery_phase", "ts": t + 1.5, "phase": "restore",
+         "seconds": 0.3, "restart_count": 1, "node_rank": 0,
+         "source": "trainer"},
+        {"type": "recovery_phase", "ts": t + 2.5, "phase": "retrace",
+         "seconds": 0.9, "restart_count": 1, "node_rank": 0,
+         "source": "trainer"},
+        {"type": "recovery_phase", "ts": t + 2.6,
+         "phase": "first_step", "seconds": 0.1, "restart_count": 1,
+         "node_rank": 0, "source": "trainer"},
+        {"type": "compile_cache", "ts": t + 2.5, "hit": True,
+         "retrace_s": 0.9, "entries_before": 40,
+         "entries_after": 40, "restart_count": 1, "node_rank": 0,
+         "source": "trainer"},
+    ]
+
+
+def test_timeline_recovery_slices_and_budgets():
+    tl = flight.assemble(_mk_events())
+    slices = tl.slices_by_cat(flight.CAT_RECOVERY_PHASE)
+    assert {s.meta["phase"] for s in slices} == {
+        "spawn", "restore", "retrace", "first_step",
+    }
+    retrace = next(s for s in slices if s.meta["phase"] == "retrace")
+    assert retrace.duration == pytest.approx(0.9)
+    # compile_cache joins the instants with a readable description
+    cache = [
+        e for e in tl.instants if e["type"] == "compile_cache"
+    ]
+    assert cache
+    # the shared ingestion helper agrees
+    budgets = flight.recovery_budgets(tl.events)
+    assert budgets[(0, 1)]["retrace"] == pytest.approx(0.9)
+    assert budgets[(0, 1)]["compile_cache_hit"] is True
+    # and the incident report prints the budget with the cache mark
+    text = flight.to_report(tl)
+    assert "recovery budgets" in text
+    assert "cache=HIT" in text
+    assert "retrace=0.900s" in text
+
+
+# -- agent-side knobs ---------------------------------------------------
+
+
+def test_agent_overlap_save_knob(monkeypatch):
+    from dlrover_tpu.agent.training import ElasticTrainingAgent
+
+    monkeypatch.delenv(
+        "DLROVER_OVERLAP_BREAKPOINT_SAVE", raising=False
+    )
+    assert ElasticTrainingAgent._overlap_save_enabled()
+    monkeypatch.setenv("DLROVER_OVERLAP_BREAKPOINT_SAVE", "0")
+    assert not ElasticTrainingAgent._overlap_save_enabled()
+
+
+def test_worker_env_exports_recovery_t0(monkeypatch):
+    """The agent stamps DLROVER_RECOVERY_T0 into respawned workers'
+    env (and never into a first start's)."""
+    from dlrover_tpu.agent.training import (
+        ElasticTrainingAgent, RendezvousOutcome, WorkerSpec,
+    )
+
+    agent = ElasticTrainingAgent.__new__(ElasticTrainingAgent)
+    agent._spec = WorkerSpec(entrypoint=["x.py"])
+    agent._node_rank = 0
+    agent._restart_count = 0
+    agent._recovery_t0 = 0.0
+
+    class _C:
+        master_addr = "127.0.0.1:1"
+
+    agent._client = _C()
+    outcome = RendezvousOutcome(
+        round=1, world={0: 1}, coordinator="127.0.0.1:2"
+    )
+    env = agent._worker_env(outcome, 0)
+    assert "DLROVER_RECOVERY_T0" not in env
+    # compile-cache env always rides along
+    assert env.get("JAX_COMPILATION_CACHE_DIR")
+    agent._recovery_t0 = time.time()
+    agent._restart_count = 1
+    env = agent._worker_env(outcome, 0)
+    assert float(env["DLROVER_RECOVERY_T0"]) == pytest.approx(
+        agent._recovery_t0, abs=1e-3
+    )

@@ -276,6 +276,8 @@ def test_planned_restarts_do_not_burn_failure_budget(monkeypatch):
     agent._restart_count = 0
     agent._budget_restarts = 0
     agent._save_ckpt_hook = None
+    agent._save_thread = None
+    agent._recovery_t0 = 0.0
     agent._procs = []
     agent._forkserver = None
     agent._hang_watchdog = None

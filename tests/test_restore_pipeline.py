@@ -472,3 +472,96 @@ def test_restore_stage_histogram_observed(saver, tmp_path):
     )["count"] == before_h2d
     assert engine.last_restore_phases["h2d_s"] == 0.0
     engine.close()
+
+
+# -- restore overlap (ISSUE 10) ----------------------------------------
+
+
+def test_overlapped_restore_bit_identical_to_serial(saver, tmp_path):
+    """load_checkpoint_async (restore stages overlapped with caller
+    setup) produces BIT-identical state vs the serial load — asserted
+    via per-leaf byte digests, for both the shm and storage tiers."""
+    from dlrover_tpu.checkpoint.checkpointer import (
+        Checkpointer, StorageType,
+    )
+
+    state = _state_dict()
+    ck = Checkpointer(str(tmp_path))
+    try:
+        ck.save_checkpoint(5, state, storage_type=StorageType.DISK)
+        assert ck.wait(timeout=60)
+        _wait_tracker(tmp_path)
+
+        # shm tier
+        step_a, async_state = ck.load_checkpoint_async().result(
+            timeout=60
+        )
+        step_s, serial_state = ck.load_checkpoint()
+        assert step_a == step_s == 5
+        assert _leaf_bytes(async_state) == _leaf_bytes(serial_state)
+
+        # storage tier (fresh engine in this process would still see
+        # shm; drop the shm snapshot to force the disk path)
+        ck._engine._shm_handler.unlink()
+        step_d, disk_async = ck.load_checkpoint_async().result(
+            timeout=60
+        )
+        assert step_d == 5
+        assert _leaf_bytes(disk_async) == _leaf_bytes(serial_state)
+    finally:
+        ck.close()
+
+
+def test_engine_prefault_thread_on_respawn(saver, tmp_path,
+                                           monkeypatch):
+    """A respawned trainer (restart_count > 0) pre-faults the shm
+    snapshot on a daemon thread at engine construction — and the
+    subsequent restore still round-trips exactly."""
+    state = _state_dict()
+    eng = _engine(tmp_path)
+    try:
+        assert eng.save_to_memory(3, state)
+    finally:
+        eng.close()
+    monkeypatch.setenv("DLROVER_RESTART_COUNT", "1")
+    eng2 = _engine(tmp_path)
+    try:
+        assert eng2._prefault_thread is not None
+        eng2._prefault_thread.join(timeout=30)
+        assert not eng2._prefault_thread.is_alive()
+        cfg, restored = eng2.get_state_dict_from_memory()
+        assert cfg is not None and cfg.step == 3
+        assert _leaf_bytes(restored) == _leaf_bytes(state)
+        step, serial = eng2.load()
+        assert step == 3
+        assert _leaf_bytes(serial) == _leaf_bytes(state)
+    finally:
+        eng2.close()
+    monkeypatch.setenv("DLROVER_RESTORE_PREFETCH", "0")
+    eng3 = _engine(tmp_path)
+    try:
+        assert eng3._prefault_thread is None  # knob respected
+    finally:
+        eng3.close()
+
+
+def test_prefault_touches_whole_snapshot(saver, tmp_path):
+    """handler.prefault returns the snapshot's full byte size (every
+    page visited) and tolerates an absent snapshot."""
+    from dlrover_tpu.checkpoint.shm_handler import (
+        SharedMemoryHandler, prefault_workers,
+    )
+
+    assert prefault_workers() >= 1
+    eng = _engine(tmp_path)
+    try:
+        h = SharedMemoryHandler(0, host=False)
+        assert h.prefault() == 0  # nothing saved yet
+        assert eng.save_to_memory(9, _state_dict())
+        meta = h.metadata()
+        expect = meta["scalar_offset"] + meta["scalar_nbytes"]
+        assert h.prefault(workers=2) == expect
+        assert h.prefault(workers=1) == expect  # serial path too
+        h.close()
+    finally:
+        eng.close()
