@@ -90,6 +90,32 @@ def _flush_and_exit(code: int):
     os._exit(code)
 
 
+def _aot_preload():
+    """AOT pre-load (``DLROVER_AOT_PRETRACE``): read the job's
+    serialized step executables into template memory — every forked
+    worker INHERITS the bytes and deserializes without touching disk.
+    Bytes only: actually deserializing here would initialize an XLA
+    client whose threads do not survive the fork (the same reason the
+    template never runs an op).  Called at template start AND before
+    every fork (incremental rescan), so the entry a cold first
+    incarnation traces and writes is already in-memory for the
+    replacement fork that follows its death."""
+    if os.environ.get("DLROVER_AOT_PRETRACE", "").strip().lower() not \
+            in ("1", "true", "yes", "on"):
+        return
+    try:
+        from dlrover_tpu.common import aot_cache
+
+        n, nbytes = aot_cache.preload_entries()
+        if n:
+            logger.info(
+                "forkserver template preloaded %d AOT cache "
+                "file(s), %.1f MB", n, nbytes / 2**20,
+            )
+    except Exception:  # noqa: BLE001 - preload is best-effort
+        pass
+
+
 def _template_main(req_fd: int, ev_fd: int):
     """Runs inside the template process (see __main__ below)."""
     for mod in os.environ.get(
@@ -106,6 +132,7 @@ def _template_main(req_fd: int, ev_fd: int):
             __import__(mod)
         except Exception:  # noqa: BLE001 - preload is best-effort
             pass
+    _aot_preload()
     req = os.fdopen(req_fd, "r")
     ev = os.fdopen(ev_fd, "w")
     children: Dict[int, bool] = {}
@@ -152,6 +179,9 @@ def _template_main(req_fd: int, ev_fd: int):
         # no child forked, no reply coming) — the hardest template
         # loss for the agent to get right
         _chaos.fire("forkserver.spawn", req=spec.get("req", -1))
+        # pick up AOT entries written since the last fork (a cold
+        # first incarnation's trace) so THIS fork inherits them
+        _aot_preload()
         pid = os.fork()
         if pid == 0:
             # ---- child: become the worker

@@ -320,11 +320,17 @@ class ElasticTrainingAgent:
         :mod:`dlrover_tpu.common.compile_cache`); the directory is
         created HERE so the first worker's jax import finds it armed
         rather than silently disabling the cache."""
+        from dlrover_tpu.common.aot_cache import aot_cache_dir
         from dlrover_tpu.common.compile_cache import cache_env
 
         env = cache_env()
         try:
             os.makedirs(env["JAX_COMPILATION_CACHE_DIR"], exist_ok=True)
+            # the AOT executable cache rides the same sharing
+            # contract (aot/ under the job cache dir unless
+            # DLROVER_AOT_CACHE_DIR overrides); created here so the
+            # first incarnation's entry write never races the mkdir
+            os.makedirs(aot_cache_dir(), exist_ok=True)
         except OSError:
             pass
         return env

@@ -954,40 +954,118 @@ class CompileCacheHitOnRecovery(Invariant):
 
 
 class RetraceBelow(Invariant):
-    """Measured ``retrace_s`` of every respawned incarnation stays
-    under the ceiling — the cache hit must translate into TIME, not
-    just a filesystem witness."""
+    """Measured ``retrace + aot`` of every respawned incarnation
+    stays under the ceiling — re-establishing a runnable step
+    executable (deserialize on an AOT hit, trace+compile otherwise)
+    must translate into TIME, not just a filesystem witness."""
 
     def __init__(self, ceiling_s: float):
         self.ceiling_s = ceiling_s
         self.name = f"retrace_below[{ceiling_s:g}s]"
 
     def check(self, events, run):
-        retraces = [
-            (int(e.get("restart_count", 0) or 0),
-             float(e.get("seconds", 0.0) or 0.0))
-            for e in events
-            if e.get("type") == "recovery_phase"
-            and e.get("phase") == "retrace"
-            and int(e.get("restart_count", 0) or 0) > 0
+        # keyed by (node_rank, restart_count) — in a multi-node run
+        # one rank's fast recovery must not mask another's violation
+        budgets = flight.recovery_budgets(events)
+        totals = [
+            (key, phases.get("retrace", 0.0) + phases.get("aot", 0.0))
+            for key, phases in budgets.items()
+            if key[1] > 0 and "retrace" in phases
         ]
-        if not retraces:
+        if not totals:
             return InvariantResult(
                 self.name, False,
                 "no retrace recovery_phase event from a respawned "
                 "incarnation",
             )
-        worst = max(retraces, key=lambda x: x[1])
+        worst = max(totals, key=lambda x: x[1])
         if worst[1] > self.ceiling_s:
             return InvariantResult(
                 self.name, False,
-                f"retrace {worst[1]:.3f}s on restart #{worst[0]} > "
-                f"ceiling {self.ceiling_s}s",
+                f"retrace+aot {worst[1]:.3f}s on node{worst[0][0]} "
+                f"restart #{worst[0][1]} > ceiling {self.ceiling_s}s",
             )
         return InvariantResult(
             self.name, True,
-            f"worst retrace {worst[1]:.3f}s ≤ {self.ceiling_s}s "
-            f"across {len(retraces)} recovery(ies)",
+            f"worst retrace+aot {worst[1]:.3f}s ≤ {self.ceiling_s}s "
+            f"across {len(totals)} recovery(ies)",
+        )
+
+
+class AotCacheHitOnRecovery(Invariant):
+    """The replacement incarnation's step executable was
+    DESERIALIZED from the AOT cache (the first incarnation's miss
+    wrote the entry) — decided from the ``aot_cache`` events."""
+
+    name = "aot_cache_hit"
+
+    def check(self, events, run):
+        witnesses = [
+            e for e in events
+            if e.get("type") == "aot_cache"
+            and int(e.get("restart_count", 0) or 0) > 0
+        ]
+        if not witnesses:
+            return InvariantResult(
+                self.name, False,
+                "no aot_cache event from a respawned incarnation "
+                "(the resolve never ran)",
+            )
+        misses = [e for e in witnesses if not e.get("hit")]
+        if misses:
+            e = misses[0]
+            return InvariantResult(
+                self.name, False,
+                f"AOT miss on restart #{e.get('restart_count')}: "
+                f"resolution={e.get('resolution')} "
+                f"reason={e.get('reason', '')!r}",
+            )
+        e = witnesses[0]
+        return InvariantResult(
+            self.name, True,
+            f"AOT hit on restart #{e.get('restart_count')} "
+            f"(deserialize {e.get('load_s')}s, critical-path wait "
+            f"{e.get('wait_s', e.get('load_s'))}s)",
+        )
+
+
+class RecoveryCycleBelow(Invariant):
+    """The whole measured death→first-step budget of every respawned
+    incarnation stays under the ceiling — the sub-second-recovery
+    acceptance, decided from the summed ``recovery_phase`` events
+    (the same numbers the timeline's budget section prints)."""
+
+    def __init__(self, ceiling_s: float):
+        self.ceiling_s = ceiling_s
+        self.name = f"recovery_cycle_below[{ceiling_s:g}s]"
+
+    def check(self, events, run):
+        budgets = flight.recovery_budgets(events)
+        cycles = [
+            (count, sum(
+                v for k, v in phases.items()
+                if k in flight.RECOVERY_PHASES
+            ))
+            for (_rank, count), phases in budgets.items()
+            if count > 0 and "first_step" in phases
+        ]
+        if not cycles:
+            return InvariantResult(
+                self.name, False,
+                "no complete recovery budget from a respawned "
+                "incarnation",
+            )
+        worst = max(cycles, key=lambda x: x[1])
+        if worst[1] > self.ceiling_s:
+            return InvariantResult(
+                self.name, False,
+                f"death->first-step {worst[1]:.3f}s on restart "
+                f"#{worst[0]} > ceiling {self.ceiling_s}s",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"worst cycle {worst[1]:.3f}s ≤ {self.ceiling_s}s "
+            f"across {len(cycles)} recovery(ies)",
         )
 
 
@@ -1621,18 +1699,32 @@ def invariants_for_scenario(
             NoOrphanProcesses(marker=workdir),
         ]
     if name == "warm-recovery-cache-hit":
-        # the invisible-recovery trail: the full recovery set PLUS the
-        # compile-cache hit witnessed from events, the measured
-        # retrace under a ceiling, and the budget's phase slices on
-        # the assembled timeline.  Ceiling: a cache MISS on this toy
-        # model costs several seconds of XLA compile even on CPU; a
-        # hit pays tracing only.
+        # the invisible-recovery trail: the full recovery set PLUS
+        # the AOT deserialize witnessed from events (the first
+        # incarnation's miss wrote the entry this one hits), the
+        # compile-cache witness agreeing (status=aot-hit), the
+        # measured retrace+aot under a ceiling that separates the
+        # regimes, the WHOLE death->first-step cycle bounded, and
+        # the budget's phase slices on the assembled timeline.
+        # Ceiling calibration (measured on the 2-core gVisor CI
+        # box): an AOT hit books retrace=0 and pays only the XLA
+        # executable deserialize — 0.4-0.8 s here, ~0.1 s on
+        # unsandboxed hardware — while ANY trace costs ≥1.1 s even
+        # on an XLA-cache hit, so 1.0 s cleanly proves tracing left
+        # the critical path.  The cycle ceiling bounds the whole
+        # budget under CI wall-clock noise (typical 1.2-2.0 s,
+        # spikes from gofer contention); tighten both via the env
+        # knobs on quieter hardware.
         return default_invariants(
             total_steps, ckpt_every, workdir
         ) + [
             CompileCacheHitOnRecovery(),
+            AotCacheHitOnRecovery(),
             RetraceBelow(ceiling_s=float(os.environ.get(
-                "DLROVER_CHAOS_RETRACE_CEILING_S", "4.0"
+                "DLROVER_CHAOS_RETRACE_CEILING_S", "1.0"
+            ))),
+            RecoveryCycleBelow(ceiling_s=float(os.environ.get(
+                "DLROVER_CHAOS_CYCLE_CEILING_S", "3.0"
             ))),
             RecoveryPhasesOnTimeline(),
         ]

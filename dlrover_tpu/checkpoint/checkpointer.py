@@ -191,11 +191,14 @@ class Checkpointer:
         self, target_state: Any = None, orbax_dir: str = "",
     ) -> RestoreHandle:
         """:meth:`load_checkpoint` on a background thread: start it
-        FIRST, build the model/optimizer/jitted step while the
-        read+assemble stages run, then ``handle.result()`` — only the
-        (device-bound) tail of the restore stays serial with the
-        caller.  One restore at a time: do not save or load through
-        this checkpointer until ``result()`` returned.
+        FIRST, build the model/optimizer/jitted step — and resolve
+        the step through the AOT executable cache
+        (``RecoveryProfiler.resolve_step`` with ``restore_busy=not
+        handle.done()``) — while the read+assemble stages run, then
+        ``handle.result()``; only the (device-bound) tail of the
+        restore stays serial with the caller.  One restore at a time:
+        do not save or load through this checkpointer until
+        ``result()`` returned.
 
         Note the host-array path (no ``target_state``) performs no
         device transfers at all, so with enough setup work to hide

@@ -25,6 +25,19 @@ counts ``*-cache`` files: jax writes one per compiled executable and
 touches only the ``-atime`` sibling on a hit, so "no new entries
 across the first post-restore step" IS the cache-hit witness — checked
 from the filesystem, robust across jax versions.
+
+The witness distinguishes THREE outcomes since the AOT executable
+cache (:mod:`dlrover_tpu.common.aot_cache`) landed, surfaced as the
+``status`` field of every ``compile_cache`` event:
+
+- ``aot-hit`` — the step was deserialized whole; no trace, no XLA
+  compile, this cache was never consulted;
+- ``xla-cache-hit`` — traced, but the compile came from this cache
+  (no new ``*-cache`` entries over a warm dir);
+- ``cold`` — traced AND compiled from scratch.
+
+:func:`aot_entries` counts the AOT half so both witnesses read from
+one module.
 """
 
 import os
@@ -101,9 +114,27 @@ def enable_persistent_cache(cache_dir: str = "") -> str:
 
 def cache_entries(cache_dir: Optional[str] = None) -> int:
     """Number of compiled executables in the cache (``*-cache``
-    files; the ``-atime`` siblings are hit markers, not entries)."""
+    files; the ``-atime`` siblings are hit markers, not entries).
+
+    Deliberately a names-only ``listdir`` of the top directory (jax
+    writes the cache flat): a recursive walk stats every entry, and
+    on a sandboxed filesystem with a cold dentry cache that costs
+    ~5 ms per file — measured at 0.7 s of the recovery critical path
+    for a ~100-entry cache, swamping the very retrace it witnesses."""
     cache_dir = cache_dir if cache_dir is not None else job_cache_dir()
-    count = 0
-    for root, _dirs, files in os.walk(cache_dir):
-        count += sum(1 for f in files if f.endswith("-cache"))
-    return count
+    try:
+        return sum(
+            1 for f in os.listdir(cache_dir) if f.endswith("-cache")
+        )
+    except OSError:
+        return 0
+
+
+def aot_entries(cache_dir: Optional[str] = None) -> int:
+    """Number of serialized step executables in the AOT cache — the
+    second half of the hit witness (an ``aot-hit`` consults no
+    ``*-cache`` file at all, so counting only those would read a
+    fully-warm recovery as suspiciously idle)."""
+    from dlrover_tpu.common.aot_cache import aot_entries as _entries
+
+    return _entries(cache_dir)

@@ -99,6 +99,28 @@ def ensure_cpu_collectives():
         pass  # option gone (newer jax defaults to gloo)
 
 
+def executable_serialization():
+    """Capability probe for whole-executable AOT serialization.
+
+    Returns ``(serialize, deserialize_and_load)`` — the
+    ``jax.experimental.serialize_executable`` pair that round-trips a
+    ``Lowered.compile()`` result through bytes, including the compiled
+    XLA binary (no re-trace AND no re-compile at load) — or
+    ``(None, None)`` on a jax without it.  Callers must treat the
+    ``(None, None)`` answer as "AOT cache off", never as an error: the
+    trace-at-first-call path is always correct, just slower.
+    """
+    try:
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+            serialize,
+        )
+
+        return serialize, deserialize_and_load
+    except ImportError:
+        return None, None
+
+
 def cost_analysis(compiled) -> dict:
     """``Compiled.cost_analysis()`` normalized to one flat dict.
 

@@ -27,6 +27,18 @@ raising, which makes recovery *prefix-consistent*: either a record is
 fully visible or it (and everything after it) is gone; a decision
 that was never durably written can never be resurrected.
 
+``DLROVER_JOURNAL_FSYNC_WINDOW_S`` > 0 applies the mirror's
+group-commit trick to the LOCAL hot path: appends flush to the page
+cache and a background flusher fsyncs the batch once per window —
+per-append durability cost drops from an fsync to a write (the win
+shows in ``dlrover_master_journal_fsync_seconds``).  A master SIGKILL
+still loses nothing (the page cache outlives the process); only a
+host power cut can eat the last window, and the :data:`DURABLE_KINDS`
+terminal decisions (``job_exit`` / ``decision`` / ``resize``) keep
+per-append fsync regardless, so an acted-on decision is never
+resurrectable-by-omission.  Default 0: every append fsyncs, exactly
+the pre-window semantics.
+
 Sequence numbers make snapshot+log replay idempotent: the snapshot
 stores the seq it folded in, and replay skips log records at or below
 it, so a crash between "snapshot renamed" and "log truncated" cannot
@@ -69,6 +81,15 @@ from dlrover_tpu.telemetry.metrics import get_registry
 JOURNAL_DIR_ENV = "DLROVER_MASTER_JOURNAL_DIR"
 JOURNAL_MIRROR_DIR_ENV = "DLROVER_MASTER_JOURNAL_MIRROR_DIR"
 JOURNAL_MIRROR_INTERVAL_ENV = "DLROVER_JOURNAL_MIRROR_INTERVAL_S"
+JOURNAL_FSYNC_WINDOW_ENV = "DLROVER_JOURNAL_FSYNC_WINDOW_S"
+
+# kinds that keep per-append fsync even under a group-commit window:
+# terminal decisions whose durability-before-action the recovery
+# semantics depend on (a replayed master honors a journaled job_exit
+# instead of resurrecting the job; a resize is journaled BEFORE the
+# drain it triggers) — losing the last window of node heartbeats to a
+# power cut is harmless, losing an acted-on decision is not
+DURABLE_KINDS = frozenset({"job_exit", "decision", "resize"})
 
 MAGIC = b"DLRVJRN1\n"
 _REC = struct.Struct(">II")  # payload length, CRC32(payload)
@@ -544,9 +565,32 @@ class StateJournal:
         snapshot_every: int = 512,
         mirror_dir: Optional[str] = None,
         mirror_interval_s: Optional[float] = None,
+        fsync_window_s: Optional[float] = None,
     ):
         self.dir = journal_dir
         self._fsync = fsync
+        if fsync_window_s is None:
+            try:
+                fsync_window_s = float(
+                    os.getenv(JOURNAL_FSYNC_WINDOW_ENV, "0") or 0.0
+                )
+            except ValueError:
+                fsync_window_s = 0.0
+        # group-commit window for LOCAL appends (the mirror trick
+        # applied at home): 0 = every append fsyncs before returning
+        # (the default — full per-append durability); >0 = appends
+        # flush to the page cache and a background flusher fsyncs the
+        # batch once per window.  Records are never lost to a PROCESS
+        # crash either way (the page cache survives the master); the
+        # window is only exposed to a host power cut, and the
+        # DURABLE_KINDS terminal decisions keep per-append fsync
+        # regardless.  Replay's torn-tail truncation already covers a
+        # partially-persisted batch.
+        self._fsync_window_s = max(0.0, float(fsync_window_s))
+        self._fsync_pending = False
+        self._last_fsync = time.monotonic()
+        self._fsync_stop = threading.Event()
+        self._fsync_thread: Optional[threading.Thread] = None
         self.snapshot_every = max(1, snapshot_every)
         os.makedirs(journal_dir, exist_ok=True)
         if mirror_dir is None:
@@ -636,7 +680,23 @@ class StateJournal:
             crc = zlib.crc32(payload) & 0xFFFFFFFF
             frame = _REC.pack(len(payload), crc) + payload
             self._fh.write(frame)
-            self._flush()
+            if (
+                self._fsync_window_s <= 0
+                or kind in DURABLE_KINDS
+                or not self._fsync
+            ):
+                # durable path: flush+fsync before the mutation is
+                # acknowledged (also drains any batched appends —
+                # one fsync covers the whole fd)
+                self._flush()
+                self._fsync_pending = False
+                self._last_fsync = time.monotonic()
+            else:
+                # group-commit path: page cache now, fsync within
+                # the window on the flusher thread
+                self._fh.flush()
+                self._fsync_pending = True
+                self._ensure_fsync_flusher()
             self.entries_since_snapshot += 1
             if self.mirror is not None:
                 # enqueue only — the mirror thread group-commits; the
@@ -645,6 +705,33 @@ class StateJournal:
         _FSYNC_SECONDS.observe(time.monotonic() - t0)
         _ENTRIES_TOTAL.inc(kind=kind)
         return seq
+
+    def _ensure_fsync_flusher(self):
+        """Start the local group-commit flusher lazily (first batched
+        append); callers hold ``_io_lock``."""
+        if (
+            self._fsync_thread is not None
+            and self._fsync_thread.is_alive()
+        ):
+            return
+        self._fsync_thread = threading.Thread(
+            target=self._fsync_loop,
+            daemon=True,
+            name="journal-fsync",
+        )
+        self._fsync_thread.start()
+
+    def _fsync_loop(self):
+        while not self._fsync_stop.wait(self._fsync_window_s):
+            with self._io_lock:
+                if not self._fsync_pending:
+                    continue
+                try:
+                    self._flush()
+                except (OSError, ValueError):
+                    continue  # rotation raced the batch; retry next
+                self._fsync_pending = False
+                self._last_fsync = time.monotonic()
 
     def snapshot(self, state: Dict[str, Any],
                  seq: Optional[int] = None):
@@ -708,6 +795,10 @@ class StateJournal:
             self._fsync_dir()
             self._fh = open(self._log_path, "ab")
             self.entries_since_snapshot = tail_count
+            # the rotation rewrote+fsync'd every surviving record:
+            # any batched appends are durable in the new log
+            self._fsync_pending = False
+            self._last_fsync = time.monotonic()
             if self.mirror is not None:
                 # the rotation rides the ordered mirror queue, so any
                 # append enqueued before it lands first and anything
@@ -730,8 +821,15 @@ class StateJournal:
             # drain pending group commits so a graceful stop leaves
             # the mirror byte-equal to the local log
             self.mirror.close()
+        self._fsync_stop.set()
+        if self._fsync_thread is not None:
+            self._fsync_thread.join(timeout=5.0)
         with self._io_lock:
             try:
+                if self._fsync_pending:
+                    # graceful stop: the batched tail becomes durable
+                    self._flush()
+                    self._fsync_pending = False
                 self._fh.close()
             except OSError:
                 pass

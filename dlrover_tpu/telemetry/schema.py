@@ -128,17 +128,34 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         _s("shm_prefetch", ["bytes", "seconds"],
            ["segments", "restart_count"]),
         # measured death->first-step budget, one event per phase
-        # (spawn / import / restore / retrace / first_step) — the
-        # trainer-side RecoveryProfiler emits them and the timeline
-        # derives the recovery breakdown slices
+        # (spawn / import / restore / aot / retrace / first_step) —
+        # the trainer-side RecoveryProfiler emits them and the
+        # timeline derives the recovery breakdown slices.  `aot` is
+        # the AOT executable cache resolve: deserialize+link on a
+        # HIT (retrace collapses to 0), entry write on a MISS
         _s("recovery_phase", ["phase", "seconds", "restart_count"],
            ["node_rank"]),
         # persistent-compile-cache witness around the first
         # post-restore step: hit = no new cache entries over a warm
-        # dir (the retrace-elimination invariant's raw material)
+        # dir (the retrace-elimination invariant's raw material);
+        # status distinguishes aot-hit / xla-cache-hit / cold and
+        # aot_entries counts the serialized-executable half
         _s("compile_cache", ["hit", "restart_count"],
            ["entries_before", "entries_after", "retrace_s", "dir",
-            "node_rank"]),
+            "node_rank", "status", "aot_entries"]),
+        # AOT executable cache resolve: hit = the compiled step was
+        # DESERIALIZED (no trace); a miss carries the measured
+        # trace_s and whether the entry was written so incarnation
+        # N+1 hits; wait_s = what the critical path stalled when the
+        # resolve ran on the overlap thread; overlapped_restore =
+        # the async restore was still reading when it finished
+        _s("aot_cache", ["hit", "restart_count"],
+           ["resolution", "key", "dir", "wrote", "preloaded",
+            "seconds",
+            "load_s", "trace_s", "save_s", "wait_s", "entries",
+            "reason", "overlapped_restore", "node_rank", "fast",
+            "read_s", "unpickle_s", "deserialize_s",
+            "deserialize_cpu_s"]),
         # master journal mirrored to the checkpoint storage tier
         # (async group commit): how far the mirror lagged when a
         # batch flushed — the host-portable control plane's witness

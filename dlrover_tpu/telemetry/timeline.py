@@ -98,7 +98,7 @@ CAT_RECOVERY_PHASE = "recovery_phase"
 # phase order of one recovery budget (mirrors
 # dlrover_recovery_phase_seconds{phase})
 RECOVERY_PHASES = (
-    "spawn", "import", "restore", "retrace", "first_step",
+    "spawn", "import", "restore", "aot", "retrace", "first_step",
 )
 
 # how long after master_recovered a session resync still counts as
@@ -175,7 +175,7 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
             continue
         if etype in ("chaos_inject", "loss_spike",
                      "diagnosis_verdict", "hang_evidence",
-                     "rpc_slo_breach", "compile_cache"):
+                     "rpc_slo_breach", "compile_cache", "aot_cache"):
             tl.instants.append(e)
             continue
         if etype == "recovery_phase":
@@ -473,8 +473,19 @@ def recovery_budgets(
             )
             rec = out.setdefault(key, {})
             rec["compile_cache_hit"] = bool(e.get("hit"))
+            if e.get("status") is not None:
+                rec["compile_cache_status"] = str(e.get("status"))
             if e.get("retrace_s") is not None:
                 rec["retrace_s"] = _num(e.get("retrace_s"))
+        elif etype == "aot_cache":
+            key = (
+                int(_num(e.get("node_rank"), -1)),
+                int(_num(e.get("restart_count"), -1)),
+            )
+            rec = out.setdefault(key, {})
+            rec["aot_cache_hit"] = bool(e.get("hit"))
+            if e.get("load_s") is not None:
+                rec["aot_load_s"] = _num(e.get("load_s"))
     return out
 
 
@@ -750,12 +761,22 @@ def _describe_instant(e: Dict) -> str:
             f"{_num(e.get('threshold_s')):.3f}s"
         )
     if etype == "compile_cache":
+        status = e.get("status")
         return (
             f"{'HIT' if e.get('hit') else 'MISS'} "
-            f"restart#{e.get('restart_count')} "
+            + (f"({status}) " if status else "")
+            + f"restart#{e.get('restart_count')} "
             f"retrace={_num(e.get('retrace_s')):.3f}s "
             f"entries {e.get('entries_before')}->"
             f"{e.get('entries_after')}"
+        )
+    if etype == "aot_cache":
+        return (
+            f"{'HIT' if e.get('hit') else 'MISS'} "
+            f"restart#{e.get('restart_count')} "
+            f"load={_num(e.get('load_s')):.3f}s "
+            f"trace={_num(e.get('trace_s')):.3f}s "
+            f"wrote={bool(e.get('wrote'))}"
         )
     return f"step={e.get('step')}"
 
@@ -885,9 +906,14 @@ def to_report(
                 "  cache=HIT" if cache is True
                 else "  cache=MISS" if cache is False else ""
             )
+            aot = phases.get("aot_cache_hit")
+            aot_txt = (
+                "  aot=HIT" if aot is True
+                else "  aot=MISS" if aot is False else ""
+            )
             lines.append(
                 f"  node{rank} restart#{count}: {total:.3f}s  "
-                f"({parts}){cache_txt}"
+                f"({parts}){cache_txt}{aot_txt}"
             )
     slo_breaches = [
         e for e in tl.instants if e.get("type") == "rpc_slo_breach"

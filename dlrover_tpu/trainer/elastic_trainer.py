@@ -227,6 +227,71 @@ def make_train_step(
     return step_fn, jit_with_shardings
 
 
+def abstract_like(tree):
+    """``ShapeDtypeStruct`` twin of a pytree — the zero-cost abstract
+    example :func:`resolve_train_step` lowers against, buildable from
+    restored params or an ``eval_shape`` of the init, so the AOT
+    resolve can run BEFORE the restore joins."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            jnp.shape(x), jnp.result_type(x)
+        ),
+        tree,
+    )
+
+
+def resolve_train_step(
+    step_fn,
+    example_state,
+    example_batch,
+    profiler=None,
+    label: str = "train_step",
+    restore_busy=None,
+):
+    """Resolve the jitted train step through the AOT executable cache
+    before the first step: a warm incarnation DESERIALIZES the
+    compiled executable instead of re-tracing (the PR 10 budget's
+    dominant term), a cold one traces once and writes the entry so
+    the next incarnation hits.  With a
+    :class:`~dlrover_tpu.trainer.recovery.RecoveryProfiler` the
+    resolve books the ``aot``/``retrace`` budget phases and emits the
+    ``aot_cache``/``compile_cache`` witnesses; without one it still
+    returns a ready step (plain :func:`aot_cache.resolve_step`).
+    Examples may be concrete arrays or :func:`abstract_like` trees.
+    Always safe: any cache problem falls back to tracing."""
+    args = (example_state, example_batch)
+    if profiler is not None:
+        return profiler.resolve_step(
+            step_fn, args, label=label, restore_busy=restore_busy
+        )
+    from dlrover_tpu.common import aot_cache
+
+    return aot_cache.resolve_step(step_fn, args, label=label).fn
+
+
+def resolve_train_step_async(
+    step_fn,
+    example_builder: Callable,
+    profiler,
+    label: str = "train_step",
+    restore_busy=None,
+) -> Callable:
+    """:func:`resolve_train_step` on a daemon thread — the recovery
+    posture.  ``example_builder`` is a zero-arg callable returning
+    ``(abstract_state, abstract_batch)`` (so even the ``eval_shape``
+    cost overlaps); the returned ``join()`` yields the step and books
+    the ``aot`` phase as the join wait — the seconds the critical
+    path actually stalled, which on a warm cache rounds to zero
+    because the deserialize hid behind the restore read and the
+    model/state build."""
+    return profiler.resolve_step_async(
+        step_fn,
+        example_builder,
+        label=label,
+        restore_busy=restore_busy,
+    )
+
+
 class ElasticTrainer:
     """Step/epoch accounting with a fixed global batch across resizes
     (reference: trainer.py GradientState + _ElasticOptimizer)."""

@@ -11,6 +11,10 @@ named phases, each measured where it actually runs:
   (interpreter + jax/flax imports — near zero under a warm fork);
 - **restore**: the checkpoint restore (the engine's measured
   ``total_s``);
+- **aot**: resolving the step through the AOT executable cache
+  (:mod:`dlrover_tpu.common.aot_cache`) — on a HIT this is the
+  deserialize+link time and the retrace phase collapses to zero; on
+  a MISS it is the entry write (so incarnation N+1 hits);
 - **retrace**: the first post-restore step's trace+compile, with the
   persistent compilation cache's hit/miss witnessed from the cache
   directory (:mod:`dlrover_tpu.common.compile_cache`);
@@ -27,8 +31,9 @@ launch).
 """
 
 import os
+import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from dlrover_tpu.common import env_utils
 from dlrover_tpu.common.compile_cache import (
@@ -46,7 +51,7 @@ _REG = get_registry()
 _PHASE_SECONDS = _REG.histogram(
     "dlrover_recovery_phase_seconds",
     "Measured death->first-step recovery budget by phase "
-    "(spawn / import / restore / retrace / first_step)",
+    "(spawn / import / restore / aot / retrace / first_step)",
 )
 
 
@@ -107,6 +112,7 @@ class RecoveryProfiler:
         )
         self.phases: Dict[str, float] = {}
         self.cache_hit: Optional[bool] = None
+        self.aot_hit: Optional[bool] = None
         self.cache_dir = enable_persistent_cache() or job_cache_dir()
         try:
             self.t0 = float(os.getenv(RECOVERY_T0_ENV, "") or 0.0)
@@ -145,6 +151,235 @@ class RecoveryProfiler:
         if isinstance(total, (int, float)) and total > 0:
             self.record("restore", float(total))
 
+    def resolve_step(
+        self,
+        fn,
+        example_args,
+        label: str = "train_step",
+        cache_dir: Optional[str] = None,
+        restore_busy: Optional[bool] = None,
+    ):
+        """Resolve the jitted step through the AOT executable cache,
+        booking the budget phases and emitting the witnesses::
+
+            step = prof.resolve_step(step_fn, (abstract_state, batch))
+            ...
+            state, metrics = step(state, batch)   # no trace on a HIT
+
+        HIT: the ``aot`` phase is the deserialize+link time and
+        ``retrace`` is recorded as 0 — tracing left the critical path.
+        MISS: the lower+compile inside the resolve IS the measured
+        retrace (recorded exactly as :meth:`measured_retrace` would),
+        and the entry is written so incarnation N+1 hits.  Off or
+        failed: returns a wrapper whose first call runs under
+        :meth:`measured_retrace` — byte-for-byte today's behavior.
+
+        ``restore_busy`` (pass ``lambda: not load_handle.done()``; a
+        plain bool works too) stamps whether the async restore was
+        still reading when this resolve finished — the overlap
+        witness on the ``aot_cache`` event.  Call this BEFORE joining
+        the restore to actually overlap."""
+        from dlrover_tpu.common import aot_cache as _aot
+
+        entries_before = cache_entries(self.cache_dir)
+        t0 = time.perf_counter()
+        res = _aot.resolve_step(
+            fn, example_args, label=label, cache_dir=cache_dir
+        )
+        wall = time.perf_counter() - t0
+        return self._book_resolution(
+            res, wall, entries_before, restore_busy
+        )
+
+    def resolve_step_async(
+        self,
+        fn,
+        args_builder: Callable,
+        label: str = "train_step",
+        cache_dir: Optional[str] = None,
+        restore_busy=None,
+    ) -> Callable:
+        """:meth:`resolve_step` on a daemon thread, so the
+        deserialize (HIT) or trace+compile (MISS) — and the abstract
+        example build itself — overlap the async restore read AND the
+        caller's own model/optimizer/state construction::
+
+            join = prof.resolve_step_async(
+                step_fn, lambda: (abstract_state, abstract_batch),
+                restore_busy=lambda: not load_handle.done())
+            ... build model, join the restore, build the state ...
+            step = join()   # waits only for what did not overlap
+
+        The ``aot`` budget phase books the JOIN WAIT — the seconds
+        the critical path actually stalled, which is what the
+        sub-second cycle is made of — while the ``aot_cache`` event
+        keeps the thread-measured ``load_s``/``trace_s``/``save_s``
+        so the true deserialize cost stays visible."""
+        from dlrover_tpu.common import aot_cache as _aot
+
+        entries_before = cache_entries(self.cache_dir)
+        holder: Dict[str, object] = {}
+        t0 = time.perf_counter()
+
+        def run():
+            try:
+                # the builder is passed THROUGH (not called): on the
+                # warm fast path the label index resolves without
+                # ever building the abstract examples
+                holder["res"] = _aot.resolve_step(
+                    fn, args_builder, label=label, cache_dir=cache_dir
+                )
+            except Exception as e:  # noqa: BLE001 - never crash
+                holder["res"] = _aot.Resolution(
+                    fn=fn, source="off", deferred=True,
+                    reason=f"async resolve failed: {e}",
+                )
+
+        thread = threading.Thread(
+            target=run, daemon=True, name="aot-resolve"
+        )
+        thread.start()
+
+        def join(timeout: Optional[float] = None):
+            w0 = time.perf_counter()
+            thread.join(timeout=timeout)
+            wait = time.perf_counter() - w0
+            res = holder.get("res")
+            if res is None:  # timeout: trace inline, never wedge
+                res = _aot.Resolution(
+                    fn=fn, source="off", deferred=True,
+                    reason="async resolve timed out",
+                )
+            wall = time.perf_counter() - t0
+            return self._book_resolution(
+                res, wall, entries_before, restore_busy,
+                aot_phase_s=wait,
+            )
+
+        return join
+
+    def _book_resolution(
+        self,
+        res,
+        wall: float,
+        entries_before: int,
+        restore_busy=None,
+        aot_phase_s: Optional[float] = None,
+    ):
+        """Book an :class:`aot_cache.Resolution` into the budget
+        phases and emit the ``aot_cache`` + ``compile_cache``
+        witnesses; returns the callable the training loop should use.
+        ``aot_phase_s`` overrides the booked ``aot`` phase (the async
+        path passes the join wait — the critical-path cost — while
+        the event keeps the thread-measured times)."""
+        from dlrover_tpu.common import aot_cache as _aot
+
+        aot_n = _aot.aot_entries(res.dir) if res.dir else 0
+        event = {
+            "hit": res.hit,
+            # "resolution", not "source": the event envelope's
+            # source field is the emitting process's identity
+            "resolution": res.source,
+            "key": res.key,
+            "dir": res.dir,
+            "wrote": res.wrote,
+            "preloaded": res.preloaded,
+            "seconds": round(wall, 4),
+            "load_s": round(res.load_s, 4),
+            "trace_s": round(res.trace_s, 4),
+            "save_s": round(res.save_s, 4),
+            "entries": aot_n,
+            "restart_count": self.restart_count,
+            "node_rank": self.node_rank,
+        }
+        if aot_phase_s is not None:
+            event["wait_s"] = round(aot_phase_s, 4)
+        for k, v in res.extra.items():
+            event[k] = round(v, 4) if isinstance(v, float) else v
+        if res.reason:
+            event["reason"] = res.reason
+        if restore_busy is not None:
+            busy = restore_busy() if callable(restore_busy) else (
+                restore_busy
+            )
+            event["overlapped_restore"] = bool(busy)
+        if res.source == "aot":
+            self.aot_hit = True
+            self.cache_hit = True
+            self.record(
+                "aot",
+                res.load_s if aot_phase_s is None else aot_phase_s,
+            )
+            # no tracing happened anywhere: the retrace phase the
+            # invariants/budget sum over is genuinely zero
+            self.record("retrace", 0.0)
+            emit_event("aot_cache", **event)
+            self._emit_compile_cache(
+                hit=True, status="aot-hit", retrace_s=0.0,
+                entries_before=entries_before,
+                entries_after=cache_entries(self.cache_dir),
+                aot_entries=aot_n,
+            )
+            return res.fn
+        self.aot_hit = False
+        if res.source == "trace" and not res.deferred:
+            # the eager lower+compile inside the resolve IS the
+            # measured retrace; the entry write rides the aot phase
+            self.record("retrace", res.trace_s)
+            self.record("aot", res.load_s + res.save_s)
+            entries_after = cache_entries(self.cache_dir)
+            hit = entries_before > 0 and entries_after <= entries_before
+            self.cache_hit = hit
+            emit_event("aot_cache", **event)
+            self._emit_compile_cache(
+                hit=hit,
+                status="xla-cache-hit" if hit else "cold",
+                retrace_s=res.trace_s,
+                entries_before=entries_before,
+                entries_after=entries_after,
+                aot_entries=aot_n,
+            )
+            return res.fn
+        # off / failed resolve: keep today's semantics — the first
+        # call traces under the measured_retrace bracket (still books
+        # the failed load attempt so the budget stays complete)
+        self.record(
+            "aot",
+            res.load_s if aot_phase_s is None else aot_phase_s,
+        )
+        emit_event("aot_cache", **event)
+        inner = res.fn
+        done = [False]
+        profiler = self
+
+        def first_call_measured(*args, **kwargs):
+            if done[0]:
+                return inner(*args, **kwargs)
+            done[0] = True
+            with profiler.measured_retrace() as r:
+                out = inner(*args, **kwargs)
+                r.block(out)
+            return out
+
+        return first_call_measured
+
+    def _emit_compile_cache(
+        self, hit, status, retrace_s, entries_before, entries_after,
+        aot_entries,
+    ):
+        emit_event(
+            "compile_cache",
+            hit=hit,
+            status=status,
+            entries_before=entries_before,
+            entries_after=entries_after,
+            aot_entries=aot_entries,
+            retrace_s=round(retrace_s, 4),
+            dir=self.cache_dir,
+            restart_count=self.restart_count,
+            node_rank=self.node_rank,
+        )
+
     def measured_retrace(self) -> "_Retrace":
         """Bracket the FIRST post-restore step::
 
@@ -166,7 +401,8 @@ class RecoveryProfiler:
         which were measured inside it)."""
         elapsed = time.perf_counter() - self._first_step_t0
         inner = sum(
-            self.phases.get(p, 0.0) for p in ("restore", "retrace")
+            self.phases.get(p, 0.0)
+            for p in ("restore", "retrace", "aot")
         )
         self.record("first_step", max(0.0, elapsed - inner))
         if self.t0 > 0:
@@ -208,14 +444,14 @@ class _Retrace:
         hit = self._before > 0 and after <= self._before
         self._p.cache_hit = hit
         self._p.record("retrace", retrace_s)
-        emit_event(
-            "compile_cache",
+        from dlrover_tpu.common.aot_cache import aot_entries
+
+        self._p._emit_compile_cache(
             hit=hit,
+            status="xla-cache-hit" if hit else "cold",
+            retrace_s=retrace_s,
             entries_before=self._before,
             entries_after=after,
-            retrace_s=round(retrace_s, 4),
-            dir=self._p.cache_dir,
-            restart_count=self._p.restart_count,
-            node_rank=self._p.node_rank,
+            aot_entries=aot_entries(),
         )
         return False

@@ -773,3 +773,109 @@ def test_resync_reconciles_multiple_acks_in_one_window(tmp_path):
     ds = tm._datasets["ds"]
     assert t0.task_id not in ds.doing and t1.task_id not in ds.doing
     assert ds.completed_count == 2
+
+
+# -- local append group-commit (fsync window) -------------------------------
+
+
+def test_fsync_window_batches_local_appends(tmp_path, monkeypatch):
+    """With DLROVER_JOURNAL_FSYNC_WINDOW_S armed, routine appends
+    flush to the page cache and skip the per-append fsync; the
+    records are still fully replayable (a process crash loses
+    nothing — only a host power cut can eat the open window)."""
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        jmod.os, "fsync",
+        lambda fd: (calls.append(fd), real_fsync(fd))[1],
+    )
+    j = StateJournal(str(tmp_path), fsync_window_s=30.0)
+    base = len(calls)
+    for i in range(40):
+        j.append("node", {"i": i})
+    assert len(calls) == base  # zero fsyncs for 40 batched appends
+    j.close()  # graceful stop drains the batch durably
+    assert len(calls) > base
+    r = replay_dir(str(tmp_path))
+    assert [d["i"] for _s, k, d in r.entries if k == "node"] == list(
+        range(40)
+    )
+
+
+def test_fsync_window_terminal_kinds_stay_durable(
+    tmp_path, monkeypatch
+):
+    """Terminal decisions (job_exit / decision / resize) keep the
+    per-append fsync even under a window: an acted-on decision must
+    never be resurrectable-by-omission after a power cut."""
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        jmod.os, "fsync",
+        lambda fd: (calls.append(fd), real_fsync(fd))[1],
+    )
+    j = StateJournal(str(tmp_path), fsync_window_s=30.0)
+    j.append("node", {"i": 0})
+    base = len(calls)
+    j.append("job_exit", {"reason": "finished"})
+    assert len(calls) == base + 1  # the terminal kind fsynced inline
+    j.append("resize", {"target": 2})
+    assert len(calls) == base + 2
+    j.close()
+
+
+def test_fsync_window_flusher_commits_within_window(tmp_path):
+    """The background flusher fsyncs the open batch about once per
+    window without any further appends."""
+    j = StateJournal(str(tmp_path), fsync_window_s=0.1)
+    for i in range(5):
+        j.append("node", {"i": i})
+    assert j._fsync_pending
+    deadline = time.time() + 5.0
+    while time.time() < deadline and j._fsync_pending:
+        time.sleep(0.02)
+    assert not j._fsync_pending
+    j.close()
+
+
+def test_fsync_window_default_preserves_per_append_durability(
+    tmp_path, monkeypatch
+):
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        jmod.os, "fsync",
+        lambda fd: (calls.append(fd), real_fsync(fd))[1],
+    )
+    monkeypatch.delenv("DLROVER_JOURNAL_FSYNC_WINDOW_S", raising=False)
+    j = StateJournal(str(tmp_path))
+    base = len(calls)
+    for i in range(5):
+        j.append("node", {"i": i})
+    assert len(calls) == base + 5  # one fsync per append, as before
+    j.close()
+
+
+def test_fsync_window_env_arms_batching(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLROVER_JOURNAL_FSYNC_WINDOW_S", "15")
+    j = StateJournal(str(tmp_path))
+    assert j._fsync_window_s == 15.0
+    j.append("node", {"i": 1})
+    assert j._fsync_pending
+    j.close()
+
+
+def test_fsync_window_snapshot_rotation_clears_batch(tmp_path):
+    """A snapshot rotation rewrites+fsyncs the surviving log, so the
+    open batch is durable afterwards and replay sees everything."""
+    j = StateJournal(str(tmp_path), fsync_window_s=30.0)
+    for i in range(10):
+        j.append("node", {"i": i})
+    assert j._fsync_pending
+    j.snapshot({"state": "s"})
+    assert not j._fsync_pending
+    j.append("node", {"i": 10})
+    j.close()
+    r = replay_dir(str(tmp_path))
+    assert r.snapshot == {"state": "s"}
+    assert [d["i"] for _s, k, d in r.entries] == [10]
