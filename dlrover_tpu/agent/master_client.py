@@ -29,6 +29,17 @@ from dlrover_tpu.telemetry.events import emit_event
 # takes ~1-2 s locally, minutes on a cluster scheduler
 DEFAULT_RESYNC_TIMEOUT = 120.0
 
+# step-report piggybacking (fleet fan-in relief, measured by the
+# fleet load harness): when armed, report_global_step coalesces —
+# the latest step rides the next heartbeat, or is flushed directly
+# once per window — instead of paying one RPC per step.  The master
+# only needs the LATEST step (SpeedMonitor keeps a monotone max), so
+# coalescing is semantically safe; the cost is sample density in the
+# speed window, which is why it defaults OFF outside the harness.
+STEP_PIGGYBACK_ENV = "DLROVER_STEP_PIGGYBACK"
+STEP_PIGGYBACK_WINDOW_ENV = "DLROVER_STEP_PIGGYBACK_WINDOW_S"
+DEFAULT_STEP_PIGGYBACK_WINDOW = 2.0
+
 
 def retry_request(func):
     """Retry an RPC a few times before giving up (reference:
@@ -60,10 +71,22 @@ class MasterClient:
     _instance: Optional["MasterClient"] = None
     _lock = threading.Lock()
 
-    def __init__(self, master_addr: str, node_id: int, node_type: str):
+    def __init__(
+        self,
+        master_addr: str,
+        node_id: int,
+        node_type: str,
+        node_rank: Optional[int] = None,
+        local_world_size: Optional[int] = None,
+    ):
+        """``node_rank`` / ``local_world_size`` override the ambient
+        env lookups — the fleet harness runs hundreds of clients in
+        one process, where a shared env cannot identify them."""
         self._addr = master_addr
         self._node_id = node_id
         self._node_type = node_type
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
         try:
             resync_timeout = float(
                 os.environ.get(
@@ -88,6 +111,28 @@ class MasterClient:
         self._recent_acks: deque = deque(maxlen=64)
         self._master_incarnation = ""
         self._client.set_session_resync(self._session_resync)
+        # step-report coalescing (see STEP_PIGGYBACK_ENV above):
+        # _pending_step holds the newest unreported (step, ts) and is
+        # drained by the next heartbeat or a windowed direct flush
+        self._piggyback = os.environ.get(
+            STEP_PIGGYBACK_ENV, ""
+        ).strip().lower() in ("1", "true", "yes", "on")
+        try:
+            self._piggyback_window = float(os.environ.get(
+                STEP_PIGGYBACK_WINDOW_ENV,
+                DEFAULT_STEP_PIGGYBACK_WINDOW,
+            ))
+        except ValueError:
+            self._piggyback_window = DEFAULT_STEP_PIGGYBACK_WINDOW
+        self._step_lock = threading.Lock()
+        self._pending_step: Optional[Tuple[int, float]] = None
+        self._last_step_send = 0.0
+
+    def session_resync(self):
+        """Replay the session-resync handshake on demand (fleet
+        harness fault mix; normally the transport's park loop drives
+        it after a master crash)."""
+        self._session_resync()
 
     def _session_resync(self):
         """Handshake replayed after the master comes back from a
@@ -96,9 +141,17 @@ class MasterClient:
         resp: msg.SessionResyncResponse = self._client.get(
             msg.SessionResyncRequest(
                 node_id=self._node_id,
-                node_rank=env_utils.get_node_rank(),
+                node_rank=(
+                    self._node_rank
+                    if self._node_rank is not None
+                    else env_utils.get_node_rank()
+                ),
                 node_type=self._node_type,
-                local_world_size=env_utils.get_local_world_size(),
+                local_world_size=(
+                    self._local_world_size
+                    if self._local_world_size is not None
+                    else env_utils.get_local_world_size()
+                ),
                 restart_count=env_utils.get_restart_count(),
                 last_step=self._last_reported_step,
                 last_acked_dataset=self._last_acked_dataset,
@@ -162,6 +215,10 @@ class MasterClient:
         return self._addr
 
     def close(self):
+        try:
+            self.flush_step_report()
+        except Exception:  # noqa: BLE001 - best-effort final drain
+            pass
         self._client.close()
 
     # -- rendezvous --------------------------------------------------------
@@ -316,19 +373,55 @@ class MasterClient:
 
     # -- metrics / monitoring ---------------------------------------------
 
-    @retry_request
     def report_global_step(self, global_step: int, timestamp: float = 0.0):
+        """Report training progress.  With ``DLROVER_STEP_PIGGYBACK``
+        armed this coalesces: the latest step is stashed to ride the
+        next heartbeat, and a direct send happens at most once per
+        piggyback window — one control-plane RPC per window instead
+        of one per step (the fleet scoreboard's top contention fix)."""
+        ts = timestamp or time.time()
+        if self._piggyback:
+            with self._step_lock:
+                self._pending_step = (int(global_step), ts)
+                due = (
+                    time.monotonic() - self._last_step_send
+                    >= self._piggyback_window
+                )
+            if not due:
+                self._last_reported_step = max(
+                    self._last_reported_step, int(global_step)
+                )
+                return True
+        return self._send_global_step(global_step, ts)
+
+    @retry_request
+    def _send_global_step(self, global_step: int, timestamp: float):
         ok = self._client.report(
             msg.GlobalStepRecord(
                 node_id=self._node_id,
                 global_step=global_step,
-                timestamp=timestamp or time.time(),
+                timestamp=timestamp,
             )
         )
+        with self._step_lock:
+            self._last_step_send = time.monotonic()
+            pending = self._pending_step
+            if pending is not None and pending[0] <= int(global_step):
+                self._pending_step = None
         self._last_reported_step = max(
             self._last_reported_step, int(global_step)
         )
         return ok
+
+    def flush_step_report(self) -> bool:
+        """Deliver any coalesced step immediately (shutdown paths and
+        the fleet agents' stop drain call this so the master's final
+        progress view is exact)."""
+        with self._step_lock:
+            pending = self._pending_step
+        if pending is None:
+            return True
+        return bool(self._send_global_step(pending[0], pending[1]))
 
     @retry_request
     def report_resource_stats(
@@ -361,11 +454,36 @@ class MasterClient:
 
     @retry_request
     def report_heartbeat(self, timestamp: float = 0.0) -> str:
-        resp: msg.HeartbeatResponse = self._client.get(
-            msg.HeartbeatRequest(
-                node_id=self._node_id, timestamp=timestamp or time.time()
-            )
+        # drain a coalesced step report on the heartbeat: the master
+        # handles the piggybacked fields exactly like a
+        # GlobalStepRecord, so one RPC does the work of two
+        with self._step_lock:
+            pending = self._pending_step
+            self._pending_step = None
+        req = msg.HeartbeatRequest(
+            node_id=self._node_id, timestamp=timestamp or time.time()
         )
+        if pending is not None:
+            req.global_step, req.step_timestamp = pending
+        try:
+            resp: msg.HeartbeatResponse = self._client.get(req)
+        except Exception:
+            if pending is not None:
+                # the step must not be lost to a failed heartbeat —
+                # restore it (newer steps win the race)
+                with self._step_lock:
+                    if (
+                        self._pending_step is None
+                        or self._pending_step[0] < pending[0]
+                    ):
+                        self._pending_step = pending
+            raise
+        if pending is not None:
+            with self._step_lock:
+                self._last_step_send = time.monotonic()
+            self._last_reported_step = max(
+                self._last_reported_step, int(pending[0])
+            )
         return resp.action
 
     # -- failure / lifecycle ----------------------------------------------

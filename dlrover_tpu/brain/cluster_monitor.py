@@ -81,6 +81,29 @@ def record_diagnosis_verdicts(
     return n
 
 
+def record_throughput_snapshot(
+    store, job_name: str, workers: int, samples_per_sec: float,
+    global_step: int = 0, timestamp: Optional[float] = None,
+) -> None:
+    """Persist one live (workers, throughput) observation.  The
+    goodput/verdict rows from :func:`ingest_job_events` explain WHERE
+    time went; these rows are the raw material of the Brain's
+    throughput heuristics (``generate_worker_plan`` groups
+    samples_per_sec by worker count), so the master's auto-ingest
+    cadence records them alongside."""
+    store.persist(
+        JobMetricRecord(
+            job_name=job_name,
+            timestamp=timestamp or time.time(),
+            workers=int(workers),
+            samples_per_sec=float(samples_per_sec),
+            finished=False,
+        ),
+        event="throughput_snapshot",
+        global_step=int(global_step),
+    )
+
+
 def ingest_job_events(
     store, job_name: str, sources: Iterable[str]
 ) -> Optional[Dict]:

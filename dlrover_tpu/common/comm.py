@@ -59,6 +59,31 @@ _RPC_RESYNC_RECONNECTS_TOTAL = _get_registry().counter(
     "dlrover_rpc_resync_reconnects_total",
     "Parked clients that found the master back and resumed",
 )
+# fleet fan-in visibility: the threaded server spawns one thread per
+# connection — with hundreds of agents that pile-up was invisible.
+# state: accepted (lifetime), active (now), peak (high-water)
+_CONNS_GAUGE = _get_registry().gauge(
+    "dlrover_master_connections",
+    "Message-server connections by state (accepted/active/peak)",
+)
+_CONNS_REJECTED_TOTAL = _get_registry().counter(
+    "dlrover_master_conns_rejected_total",
+    "Connects refused by the DLROVER_MASTER_MAX_CONNS guard",
+)
+# server-side turnaround per bare verb (frame decode -> response
+# sent), next to the handler-only dlrover_rpc_seconds: the difference
+# is dispatch overhead (response cache, chaos hook, pickling, send)
+_RPC_SERVER_SECONDS = _get_registry().histogram(
+    "dlrover_rpc_server_seconds",
+    "Server-side request turnaround by bare verb (frame decode to "
+    "response sent); subtracting the handler-only "
+    "dlrover_rpc_seconds leaves the dispatch overhead",
+)
+
+# connection-guard knob: reject connects beyond this many concurrent
+# connections with a clean RemoteError frame instead of a silent
+# thread pile-up; 0 = unlimited (the historical behaviour)
+MAX_CONNS_ENV = "DLROVER_MASTER_MAX_CONNS"
 
 # reconnect-hardening knobs (chaos partition scenarios hammer this
 # path; prod defaults preserve the former envelope: 0.5 s doubling,
@@ -213,7 +238,19 @@ class RemoteError(Exception):
     def __init__(self, type_name: str, message: str, tb: str = ""):
         super().__init__(f"{type_name}: {message}")
         self.type_name = type_name
+        self.remote_message = message
         self.remote_traceback = tb
+
+    def __reduce__(self):
+        # Exception's default reduce replays ``args`` (the single
+        # joined string) into the two-arg __init__ — every error
+        # frame un-pickled client-side died with a TypeError instead
+        # of surfacing the typed remote failure
+        return (
+            RemoteError,
+            (self.type_name, self.remote_message,
+             self.remote_traceback),
+        )
 
 
 class ResponseCache:
@@ -255,8 +292,11 @@ class _Connection(socketserver.BaseRequestHandler):
             except Exception:
                 logger.exception("malformed frame; dropping connection")
                 return
+            t_dispatch = time.perf_counter()
+            bare_verb = "?"
             try:
                 verb, node_id, node_type, req_id, message = frame[:5]
+                bare_verb = verb if verb in ("get", "report") else "?"
                 trace_ctx = frame[5] if len(frame) > 5 else None
                 try:
                     # server-side chaos: a drop kills the connection
@@ -293,6 +333,9 @@ class _Connection(socketserver.BaseRequestHandler):
                 )
             try:
                 _send_frame(sock, resp)
+                _RPC_SERVER_SECONDS.observe(
+                    time.perf_counter() - t_dispatch, verb=bare_verb
+                )
             except (ConnectionError, OSError):
                 return
             except Exception:
@@ -311,8 +354,102 @@ class _Connection(socketserver.BaseRequestHandler):
 
 
 class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    """Thread-per-connection server with connection accounting and
+    an optional concurrency guard.
+
+    The base class spawns an unbounded thread per accepted socket
+    with zero visibility — under fleet-scale fan-in (hundreds of
+    persistent agent connections) that is both the resource to watch
+    and the one to bound.  Accounting feeds the
+    ``dlrover_master_connections`` gauge; ``max_conns`` (ctor /
+    ``DLROVER_MASTER_MAX_CONNS``) rejects over-limit connects with a
+    clean :class:`RemoteError` frame (the client surfaces it as a
+    typed exception instead of a hang) before any thread is spawned.
+    """
+
     allow_reuse_address = True
     daemon_threads = True
+
+    def __init__(self, addr, handler_cls, max_conns: int = 0):
+        self.max_conns = int(max_conns)
+        self._conn_lock = threading.Lock()
+        self._conns_active = 0
+        self._conns_accepted = 0
+        self._conns_peak = 0
+        super().__init__(addr, handler_cls)
+
+    def _publish_conn_stats(self):
+        # caller holds _conn_lock
+        _CONNS_GAUGE.set(self._conns_accepted, state="accepted")
+        _CONNS_GAUGE.set(self._conns_active, state="active")
+        _CONNS_GAUGE.set(self._conns_peak, state="peak")
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            if self.max_conns and self._conns_active >= self.max_conns:
+                reject = True
+            else:
+                reject = False
+                self._conns_active += 1
+                self._conns_accepted += 1
+                self._conns_peak = max(
+                    self._conns_peak, self._conns_active
+                )
+            self._publish_conn_stats()
+        if reject:
+            _CONNS_REJECTED_TOTAL.inc()
+            logger.warning(
+                "connection from %s rejected: %d active >= "
+                "max_conns %d", client_address, self._conns_active,
+                self.max_conns,
+            )
+            # the handshake runs on a SHORT-LIVED thread (bounded by
+            # the rejection rate, not the connection count — the
+            # guard's point stands): the client's first request must
+            # be DRAINED before closing, or close() on a socket with
+            # unread bytes RSTs and can discard the queued error
+            # frame — the client would then see ECONNRESET and burn
+            # its whole retry envelope instead of failing typed
+            threading.Thread(
+                target=self._reject_conn,
+                args=(request,),
+                daemon=True,
+                name="conn-reject",
+            ).start()
+            return
+        super().process_request(request, client_address)
+
+    def _reject_conn(self, request):
+        try:
+            request.settimeout(2.0)
+            try:
+                _recv_frame(request)  # drain the first request
+            except Exception:  # noqa: BLE001 - any garbage is fine,
+                pass  # the point is emptying the receive queue
+            _send_frame(request, RemoteError(
+                "ResourceExhausted",
+                f"master connection limit {self.max_conns} "
+                "reached",
+            ))
+            try:
+                request.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+        except (OSError, ValueError):
+            pass
+        finally:
+            self.shutdown_request(request)
+
+    def finish_request(self, request, client_address):
+        # runs on the per-connection thread; the finally fires when
+        # the handler returns, so `active` tracks live threads (the
+        # reject path never incremented and never lands here)
+        try:
+            super().finish_request(request, client_address)
+        finally:
+            with self._conn_lock:
+                self._conns_active = max(0, self._conns_active - 1)
+                self._publish_conn_stats()
 
 
 class MessageServer:
@@ -325,13 +462,22 @@ class MessageServer:
         handler: RequestHandler,
         host: str = "0.0.0.0",
         cache_capacity: int = 8192,
+        max_conns: Optional[int] = None,
     ):
         """``cache_capacity`` bounds the idempotent-retry response
         cache; servers whose responses are LARGE (e.g. the coworker
         data service shipping whole batches) should size it to what
-        memory affords x the retry window they must cover."""
+        memory affords x the retry window they must cover.
+        ``max_conns`` (default ``DLROVER_MASTER_MAX_CONNS``, 0 =
+        unlimited) bounds concurrent connections — each costs a
+        server thread, and fleet-scale fan-in must degrade with a
+        clean typed error instead of a thread pile-up."""
         self.handler = handler
-        self._server = _ThreadingTCPServer((host, port), _Connection)
+        if max_conns is None:
+            max_conns = int(_env_float(MAX_CONNS_ENV, 0))
+        self._server = _ThreadingTCPServer(
+            (host, port), _Connection, max_conns=max_conns
+        )
         self._server.handler = handler  # type: ignore[attr-defined]
         self._server.response_cache = ResponseCache(  # type: ignore[attr-defined]
             capacity=cache_capacity

@@ -232,6 +232,11 @@ class ModelInfo(Message):
 class HeartbeatRequest(Message):
     node_id: int = 0
     timestamp: float = 0.0
+    # step-report piggybacking (fleet fan-in relief): a client with
+    # DLROVER_STEP_PIGGYBACK armed folds its latest global step into
+    # the heartbeat instead of paying a second RPC; -1 = none riding
+    global_step: int = -1
+    step_timestamp: float = 0.0
 
 
 @dataclass

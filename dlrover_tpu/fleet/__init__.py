@@ -1,0 +1,36 @@
+"""Fleet observatory: synthetic-agent load harness + control-plane
+scoreboard + SLO-green capacity search.
+
+The reference system's master is a cluster-scale hub serving hundreds
+of elastic agents; everything this repo measured before this package
+ran at 1-3 nodes.  The harness closes that gap without hardware:
+
+- :class:`~dlrover_tpu.fleet.synthetic_agent.SyntheticAgent` drives a
+  REAL :class:`~dlrover_tpu.agent.master_client.MasterClient` through
+  the production verb mix (rendezvous join, heartbeats, step/speed
+  reports, shard lease/ack, KV barriers, session resync after forced
+  reconnects) with configurable cadence, jitter and fault mix;
+- :class:`~dlrover_tpu.fleet.runner.FleetRunner` ramps hundreds of
+  them against ONE real journal-backed master in-process and performs
+  the SLO-green capacity search (max sustained agents);
+- :class:`~dlrover_tpu.fleet.scoreboard.Scoreboard` watches the
+  control plane while they run: windowed per-verb latency quantiles
+  over ``dlrover_rpc_seconds``, servicer in-flight, connection
+  fan-in, journal append lock-wait and fsync-batch depth — emitted as
+  periodic ``fleet_report`` events that feed the timeline/report
+  pipeline.
+"""
+
+from dlrover_tpu.fleet.runner import FleetRunner
+from dlrover_tpu.fleet.scoreboard import Scoreboard
+from dlrover_tpu.fleet.synthetic_agent import (
+    AgentProfile,
+    SyntheticAgent,
+)
+
+__all__ = [
+    "AgentProfile",
+    "FleetRunner",
+    "Scoreboard",
+    "SyntheticAgent",
+]

@@ -43,6 +43,10 @@ RESIZE_GRACE_ENV = "DLROVER_RESIZE_GRACE_S"
 # re-deliver the resize action to an agent that has not re-joined
 # after this long (lost heartbeat ack); 0 disables re-delivery
 RESIZE_REDELIVER_ENV = "DLROVER_RESIZE_REDELIVER_S"
+# how often the Brain decision source (when attached) is consulted
+# for a throughput-driven target; resizes are expensive, so this is
+# deliberately much slower than the capacity/operator paths
+BRAIN_RESIZE_INTERVAL_ENV = "DLROVER_BRAIN_RESIZE_INTERVAL_S"
 
 _RESIZE_SECONDS = get_registry().histogram(
     "dlrover_resize_seconds",
@@ -90,6 +94,14 @@ class ResizeCoordinator:
         self.grace_s = _env_float(RESIZE_GRACE_ENV, 30.0)
         self.redeliver_s = _env_float(RESIZE_REDELIVER_ENV, 30.0)
         self.resizes = 0
+        # Brain decision source (set_brain): a third input next to
+        # capacity mismatches and operator requests — the standing
+        # cluster optimizer's throughput heuristic proposes targets
+        self._brain = None
+        self._brain_interval = _env_float(
+            BRAIN_RESIZE_INTERVAL_ENV, 60.0
+        )
+        self._last_brain_poll = 0.0
         # debounce: (target, first-observed ts) of the current mismatch
         self._observed: Optional[tuple] = None
         # operator request (servicer thread) consumed by the next poll
@@ -102,8 +114,10 @@ class ResizeCoordinator:
 
     @property
     def enabled(self) -> bool:
-        return self.max_nodes > self.min_nodes or bool(
-            os.getenv("DLROVER_AUTO_RESIZE", "")
+        return (
+            self.max_nodes > self.min_nodes
+            or self._brain is not None
+            or bool(os.getenv("DLROVER_AUTO_RESIZE", ""))
         )
 
     # -- inputs ------------------------------------------------------------
@@ -115,6 +129,53 @@ class ResizeCoordinator:
         logger.info(
             "operator resize request: target=%s (%s)", target, reason
         )
+
+    def set_brain(self, brain, interval_s: Optional[float] = None):
+        """Attach a Brain decision source: anything with the
+        ``generate_worker_plan(current_workers, speed_monitor)``
+        contract (:class:`~dlrover_tpu.brain.service.BrainService`).
+        Consulted from the idle poll at ``interval_s`` cadence; its
+        plan becomes a journaled resize decision with reason
+        ``brain:<comment>`` — the same drain/reconverge machinery as
+        node-loss and operator resizes, different brain."""
+        self._brain = brain
+        if interval_s is not None:
+            self._brain_interval = max(1.0, float(interval_s))
+
+    def _poll_brain(self, current: int, now: float) -> bool:
+        """One Brain consultation; returns True when it decided."""
+        if self._brain is None:
+            return False
+        if now - self._last_brain_poll < self._brain_interval:
+            return False
+        self._last_brain_poll = now
+        try:
+            plan = self._brain.generate_worker_plan(
+                current, self._speed
+            )
+        except Exception:  # noqa: BLE001 - an optimizer bug must
+            logger.exception("brain worker plan failed")  # not resize
+            return False
+        if not plan or not getattr(plan, "worker_count", 0):
+            return False
+        target = self._align(int(plan.worker_count))
+        if target == current:
+            return False
+        available = len(self._available_nodes())
+        if target > available:
+            # a grow beyond live capacity would start a resize whose
+            # rendezvous can never complete — the Brain proposes,
+            # the liveness view disposes
+            logger.info(
+                "brain proposed world=%s but only %s nodes are "
+                "alive; deferring", target, available,
+            )
+            return False
+        comment = getattr(plan, "comment", "") or "throughput"
+        self._decide(
+            target, current, f"brain:{comment}", now, now
+        )
+        return True
 
     def _align(self, target: int) -> int:
         unit = self.node_unit
@@ -166,6 +227,8 @@ class ResizeCoordinator:
             target = self._align(target)
             if target != current:
                 self._decide(target, current, reason, now, now)
+            return
+        if self._poll_brain(current, now):
             return
         available = self._available_nodes()
         target = self._align(len(available))

@@ -118,6 +118,24 @@ _MIRROR_LAG_SECONDS = _REG.gauge(
     "Age of the oldest record the mirror had not yet flushed at the "
     "last group commit (bounded by the group-commit window)",
 )
+# fleet fan-in split: the journal's single io lock is the master's
+# hot-append serialization point — under hundreds of agents, time
+# spent WAITING for the lock (queueing) is distinct from time spent
+# writing/fsyncing (io), and the scoreboard reads both
+_LOCK_WAIT_SECONDS = _REG.histogram(
+    "dlrover_master_journal_lock_wait_seconds",
+    "Time an append spent waiting for the journal io lock (the "
+    "queueing half of dlrover_master_journal_fsync_seconds)",
+)
+_PENDING_FSYNC = _REG.gauge(
+    "dlrover_master_journal_pending_fsync",
+    "Appends written to the page cache but not yet fsync'd under "
+    "DLROVER_JOURNAL_FSYNC_WINDOW_S (0 when the window is off)",
+)
+_MIRROR_QUEUE_DEPTH = _REG.gauge(
+    "dlrover_master_journal_mirror_queue",
+    "Records enqueued for the mirror's next group commit",
+)
 
 
 @dataclass
@@ -325,6 +343,7 @@ class _JournalMirror:
     def enqueue_append(self, frame: bytes):
         with self._cv:
             self._tasks.append(("append", frame, time.monotonic()))
+            _MIRROR_QUEUE_DEPTH.set(len(self._tasks))
             # no notify: appends ride the next interval tick — THAT is
             # the group commit; only rotation/flush wake the thread
 
@@ -349,6 +368,7 @@ class _JournalMirror:
     def _drain(self) -> List[tuple]:
         with self._cv:
             tasks, self._tasks = self._tasks, []
+            _MIRROR_QUEUE_DEPTH.set(0)
         return tasks
 
     def _loop(self):
@@ -588,6 +608,7 @@ class StateJournal:
         # partially-persisted batch.
         self._fsync_window_s = max(0.0, float(fsync_window_s))
         self._fsync_pending = False
+        self._pending_count = 0
         self._last_fsync = time.monotonic()
         self._fsync_stop = threading.Event()
         self._fsync_thread: Optional[threading.Thread] = None
@@ -624,6 +645,10 @@ class StateJournal:
         # run-loop's snapshot cadence) — an unsynchronized write would
         # interleave frame bytes and CRC-poison the log
         self._io_lock = threading.Lock()
+        # bumped whenever rotation replaces the log's inode under
+        # the same path; the group-commit flusher keys its separate
+        # fsync fd off it (see _fsync_loop)
+        self._log_generation = 0
         fresh = not os.path.exists(self._log_path)
         self._fh = open(self._log_path, "ab")
         if fresh or self._fh.tell() == 0:
@@ -672,6 +697,7 @@ class StateJournal:
         are RPC handler threads, monitor threads and the run loop."""
         t0 = time.monotonic()
         with self._io_lock:
+            _LOCK_WAIT_SECONDS.observe(time.monotonic() - t0)
             self._seq += 1
             seq = self._seq
             payload = json.dumps(
@@ -690,12 +716,18 @@ class StateJournal:
                 # one fsync covers the whole fd)
                 self._flush()
                 self._fsync_pending = False
+                self._pending_count = 0
+                _PENDING_FSYNC.set(0)
                 self._last_fsync = time.monotonic()
             else:
                 # group-commit path: page cache now, fsync within
                 # the window on the flusher thread
                 self._fh.flush()
                 self._fsync_pending = True
+                self._pending_count = (
+                    getattr(self, "_pending_count", 0) + 1
+                )
+                _PENDING_FSYNC.set(self._pending_count)
                 self._ensure_fsync_flusher()
             self.entries_since_snapshot += 1
             if self.mirror is not None:
@@ -722,16 +754,74 @@ class StateJournal:
         self._fsync_thread.start()
 
     def _fsync_loop(self):
-        while not self._fsync_stop.wait(self._fsync_window_s):
-            with self._io_lock:
-                if not self._fsync_pending:
-                    continue
+        # Two convoy killers, both measured by the fleet scoreboard
+        # at hundreds of agents (seconds-long append p99 without
+        # them):
+        # 1. the fsync runs OUTSIDE the io lock — holding it through
+        #    a slow storage flush parks every appender behind the
+        #    flusher;
+        # 2. the flush primitive is fdatasync through the flusher's
+        #    OWN read-only fd — an append-only log's durability needs
+        #    exactly data + size, which fdatasync covers (the classic
+        #    WAL sync method), while a full fsync on gVisor-style
+        #    filesystems takes a metadata path that stalls seconds
+        #    under CPU saturation AND serializes in-kernel with
+        #    write()s on the same inode, conveying every appender.
+        #    Measured at 200 synthetic agents: worst verb p99 2-5 s
+        #    with fsync, 5 ms with fdatasync.
+        # Claiming the batch under the lock keeps the contract:
+        # records appended while the fsync is in flight re-arm
+        # _fsync_pending and ride the next window; a rotation racing
+        # the fsync replaced the inode AFTER fsync'ing the surviving
+        # tail itself, so fsync'ing the stale inode loses nothing.
+        sync_fd = -1
+        sync_gen = -1
+        try:
+            while not self._fsync_stop.wait(self._fsync_window_s):
+                with self._io_lock:
+                    if not self._fsync_pending:
+                        continue
+                    try:
+                        self._fh.flush()
+                    except (OSError, ValueError):
+                        continue  # rotation raced; retry next tick
+                    self._fsync_pending = False
+                    self._pending_count = 0
+                    _PENDING_FSYNC.set(0)
+                    gen = self._log_generation
                 try:
-                    self._flush()
+                    if sync_gen != gen or sync_fd < 0:
+                        # rotation replaced the inode under the same
+                        # path: reopen so the fsync covers the LIVE
+                        # log, not the replaced one
+                        if sync_fd >= 0:
+                            os.close(sync_fd)
+                        sync_fd = os.open(
+                            self._log_path, os.O_RDONLY
+                        )
+                        sync_gen = gen
+                    getattr(os, "fdatasync", os.fsync)(sync_fd)
+                    self._last_fsync = time.monotonic()
                 except (OSError, ValueError):
-                    continue  # rotation raced the batch; retry next
-                self._fsync_pending = False
-                self._last_fsync = time.monotonic()
+                    if sync_fd >= 0:
+                        try:
+                            os.close(sync_fd)
+                        except OSError:
+                            pass
+                    sync_fd = -1
+                    sync_gen = -1
+                    # the claimed batch is NOT durable: re-arm so
+                    # the next tick retries even with no new append
+                    # (a transient sync failure must not leave the
+                    # records page-cache-only past the window bound)
+                    with self._io_lock:
+                        self._fsync_pending = True
+        finally:
+            if sync_fd >= 0:
+                try:
+                    os.close(sync_fd)
+                except OSError:
+                    pass
 
     def snapshot(self, state: Dict[str, Any],
                  seq: Optional[int] = None):
@@ -794,6 +884,7 @@ class StateJournal:
             os.replace(tmp_log, self._log_path)
             self._fsync_dir()
             self._fh = open(self._log_path, "ab")
+            self._log_generation += 1
             self.entries_since_snapshot = tail_count
             # the rotation rewrote+fsync'd every surviving record:
             # any batched appends are durable in the new log

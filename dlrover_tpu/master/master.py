@@ -56,6 +56,22 @@ _RECOVERIES_TOTAL = get_registry().counter(
     "Master crash recoveries (journal replays into a respawned "
     "master)",
 )
+_BRAIN_INGESTS_TOTAL = get_registry().counter(
+    "dlrover_brain_ingests_total",
+    "Automatic event-log ingests into the Brain datastore from the "
+    "master run loop",
+)
+
+# Brain auto-feed: DLROVER_BRAIN_DB points the master at a sqlite
+# Brain datastore — every ingest interval the run loop ships the
+# job's event logs (goodput attribution + diagnosis verdicts) plus a
+# live throughput snapshot into it, making the Brain a standing
+# optimizer fed continuously instead of a per-job afterthought.
+# DLROVER_BRAIN_RESIZE additionally wires the Brain's throughput
+# heuristic into the ResizeCoordinator as a decision source.
+BRAIN_DB_ENV = "DLROVER_BRAIN_DB"
+BRAIN_INGEST_INTERVAL_ENV = "DLROVER_BRAIN_INGEST_INTERVAL_S"
+BRAIN_RESIZE_ENV = "DLROVER_BRAIN_RESIZE"
 
 
 class JobMaster:
@@ -164,6 +180,43 @@ class JobMaster:
             node_unit=self.node_unit,
         )
         self.servicer.resize_coordinator = self.resize_coordinator
+        # -- Brain auto-feed (standing cluster optimizer) --------------
+        self.brain_store = None
+        self.brain = None
+        self._brain_ingest_interval = _env_float(
+            BRAIN_INGEST_INTERVAL_ENV, 30.0
+        )
+        self._last_brain_ingest = 0.0
+        brain_db = os.getenv(BRAIN_DB_ENV, "")
+        if brain_db:
+            try:
+                from dlrover_tpu.brain.datastore import (
+                    SqliteJobMetricsStore,
+                )
+                from dlrover_tpu.brain.service import BrainService
+
+                self.brain_store = SqliteJobMetricsStore(brain_db)
+                self.brain = BrainService(
+                    self.brain_store, job_name=self.job_name
+                )
+                if os.getenv(BRAIN_RESIZE_ENV, "").strip().lower() in (
+                    "1", "true", "yes", "on"
+                ):
+                    self.resize_coordinator.set_brain(self.brain)
+                logger.info(
+                    "brain datastore %s armed (ingest every %.0fs%s)",
+                    brain_db, self._brain_ingest_interval,
+                    ", resize decision source on"
+                    if self.resize_coordinator._brain is not None
+                    else "",
+                )
+            except Exception:  # noqa: BLE001 - an optimizer feed
+                logger.exception(  # must never kill the master
+                    "brain datastore %s unusable; auto-ingest off",
+                    brain_db,
+                )
+                self.brain_store = None
+                self.brain = None
         # -- crash recovery: state journal + replay --------------------
         self.journal: Optional[StateJournal] = None
         jdir = journal_dir or os.getenv(JOURNAL_DIR_ENV, "")
@@ -289,6 +342,47 @@ class JobMaster:
                 },
             )
 
+    def maybe_brain_ingest(self, now: Optional[float] = None) -> bool:
+        """Feed the Brain datastore on a cadence: ship the job's
+        event logs through :func:`cluster_monitor.ingest_job_events`
+        (goodput attribution + diagnosis verdicts) and persist a live
+        (workers, samples/sec) throughput snapshot — the raw material
+        of the Brain's worker-plan heuristic.  Called from the run
+        loop every poll (previously ``ingest_job_events`` existed but
+        nothing ever called it automatically); safe to call from any
+        single thread.  Returns True when an ingest ran."""
+        if self.brain_store is None:
+            return False
+        now = now or time.time()
+        if now - self._last_brain_ingest < self._brain_ingest_interval:
+            return False
+        self._last_brain_ingest = now
+        from dlrover_tpu.brain import cluster_monitor as _cm
+        from dlrover_tpu.telemetry import timeline as _timeline
+
+        try:
+            _cm.record_throughput_snapshot(
+                self.brain_store,
+                self.job_name,
+                workers=self.elastic_rdzv.latest_world_size(),
+                samples_per_sec=(
+                    self.speed_monitor.samples_per_second()
+                    or self.speed_monitor.running_speed()
+                ),
+                global_step=self.speed_monitor.completed_global_step,
+                timestamp=now,
+            )
+            _cm.ingest_job_events(
+                self.brain_store,
+                self.job_name,
+                _timeline.default_sources(),
+            )
+            _BRAIN_INGESTS_TOTAL.inc()
+            return True
+        except Exception:  # noqa: BLE001 - the optimizer feed must
+            logger.exception("brain ingest failed")  # not kill us
+            return False
+
     def update_rdzv_params(
         self, min_nodes: int, max_nodes: int, node_unit: int = 1
     ):
@@ -373,6 +467,9 @@ class JobMaster:
                     self.resize_coordinator.poll()
                 except Exception:  # noqa: BLE001 - a resize bug must
                     logger.exception("resize poll failed")  # not kill
+                # standing-optimizer feed: event logs + throughput
+                # snapshots into the Brain datastore on a cadence
+                self.maybe_brain_ingest()
                 # inference-chain diagnosis over the agents' reported
                 # evidence (stacks, hang flight data, per-node step
                 # times, step-phase breakdowns) — the hang verdict
@@ -523,6 +620,13 @@ class JobMaster:
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()
+        if self.brain_store is not None:
+            try:
+                self.brain_store.close()
+            except Exception:  # noqa: BLE001
+                logger.exception("brain store close failed")
+            self.brain_store = None
+            self.brain = None
         if self.journal is not None:
             # graceful shutdown: fold the tail into a snapshot so a
             # planned restart replays one file, then detach

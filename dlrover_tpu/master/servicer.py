@@ -38,6 +38,13 @@ _RPC_SECONDS = get_registry().histogram(
     "Master servicer dispatch latency by verb "
     "(verb.MessageType, handler execution only)",
 )
+# fleet fan-in: how many server threads sit INSIDE each verb's
+# handler right now — the scoreboard reads this to tell queueing
+# (rising in-flight, flat handler time) from slow handlers
+_RPC_INFLIGHT = get_registry().gauge(
+    "dlrover_rpc_inflight",
+    "Requests currently executing in the servicer, by verb",
+)
 
 
 class MasterServicer(RequestHandler):
@@ -95,10 +102,14 @@ class MasterServicer(RequestHandler):
     # ------------------------------------------------------------------
 
     def get(self, node_id: int, node_type: str, message):
-        with _RPC_SECONDS.time(
-            verb=f"get.{type(message).__name__}"
-        ):
-            return self._dispatch_get(node_id, node_type, message)
+        verb = f"get.{type(message).__name__}"
+        inflight = _RPC_INFLIGHT.labels(verb=verb)
+        inflight.inc()
+        try:
+            with _RPC_SECONDS.time(verb=verb):
+                return self._dispatch_get(node_id, node_type, message)
+        finally:
+            inflight.dec()
 
     def _dispatch_get(self, node_id: int, node_type: str, message):
         if isinstance(message, msg.JoinRendezvousRequest):
@@ -242,6 +253,14 @@ class MasterServicer(RequestHandler):
             self._job_manager.collect_heartbeat(
                 message.node_id, message.timestamp
             )
+            # a piggybacked step report rode the heartbeat (the
+            # agent-side coalescing that halves fleet RPC volume) —
+            # feed the speed monitor as if it were a GlobalStepRecord
+            if getattr(message, "global_step", -1) >= 0:
+                self._speed_monitor.collect_global_step(
+                    message.global_step,
+                    message.step_timestamp or message.timestamp,
+                )
             # piggyback a pending action (e.g. the hang diagnosis'
             # culprit-only restart) on the ack — delivered once
             return msg.HeartbeatResponse(
@@ -276,10 +295,16 @@ class MasterServicer(RequestHandler):
     # ------------------------------------------------------------------
 
     def report(self, node_id: int, node_type: str, message) -> bool:
-        with _RPC_SECONDS.time(
-            verb=f"report.{type(message).__name__}"
-        ):
-            return self._dispatch_report(node_id, node_type, message)
+        verb = f"report.{type(message).__name__}"
+        inflight = _RPC_INFLIGHT.labels(verb=verb)
+        inflight.inc()
+        try:
+            with _RPC_SECONDS.time(verb=verb):
+                return self._dispatch_report(
+                    node_id, node_type, message
+                )
+        finally:
+            inflight.dec()
 
     def _dispatch_report(
         self, node_id: int, node_type: str, message
@@ -324,6 +349,11 @@ class MasterServicer(RequestHandler):
             self._job_manager.collect_heartbeat(
                 message.node_id, message.timestamp
             )
+            if getattr(message, "global_step", -1) >= 0:
+                self._speed_monitor.collect_global_step(
+                    message.global_step,
+                    message.step_timestamp or message.timestamp,
+                )
             return True
 
         if isinstance(message, msg.NetworkStatusRequest):

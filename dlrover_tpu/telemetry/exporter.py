@@ -127,29 +127,80 @@ def _render_families(fams) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+# mtime/size cache for aggregate_textfiles: a hundreds-of-agents
+# deployment re-reading + re-parsing + re-labeling every .prom file
+# on EVERY /metrics scrape made the scrape itself a fan-in hot spot.
+# Keyed by path; entries hold the already-agent-labeled families so
+# an unchanged file costs one stat().  Bounded implicitly by the dump
+# population (stale paths are pruned each call).
+_AGG_CACHE: Dict[str, tuple] = {}
+_AGG_CACHE_LOCK = threading.Lock()
+
+
+def _labeled_families(path: str):
+    """Parsed + agent-labeled families for one dump file, served
+    from the mtime/size cache when the file is unchanged."""
+    try:
+        st = os.stat(path)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError as e:
+        logger.debug("cannot stat textfile dump %s: %s", path, e)
+        return None
+    with _AGG_CACHE_LOCK:
+        hit = _AGG_CACHE.get(path)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        logger.debug("cannot read textfile dump %s: %s", path, e)
+        return None
+    stem = os.path.splitext(os.path.basename(path))[0]
+    labeled = OrderedDict()
+    for name, parsed in _parse_families(text).items():
+        labeled[name] = {
+            "help": parsed["help"],
+            "type": parsed["type"],
+            "samples": [
+                _with_label(line, "agent", stem)
+                for line in parsed["samples"]
+            ],
+        }
+    with _AGG_CACHE_LOCK:
+        _AGG_CACHE[path] = (key, labeled)
+    return labeled
+
+
 def aggregate_textfiles(pattern: str) -> str:
     """Merge every textfile dump matching ``pattern`` into one
     exposition; each file's samples get an ``agent="<stem>"`` label so
-    same-named worker series never collide across agents."""
+    same-named worker series never collide across agents.  Unchanged
+    files are served from an mtime/size cache so a fleet-sized scrape
+    stays cheap; ``dlrover_metrics_aggregated_files`` reports how
+    many dumps the last scrape folded in."""
     fams: "OrderedDict[str, Dict[str, object]]" = OrderedDict()
-    for path in sorted(_glob.glob(pattern)):
-        try:
-            with open(path) as f:
-                text = f.read()
-        except OSError as e:
-            logger.debug("cannot read textfile dump %s: %s", path, e)
+    paths = sorted(_glob.glob(pattern))
+    merged_files = 0
+    for path in paths:
+        labeled = _labeled_families(path)
+        if labeled is None:
             continue
-        stem = os.path.splitext(os.path.basename(path))[0]
-        for name, parsed in _parse_families(text).items():
+        merged_files += 1
+        for name, parsed in labeled.items():
             merged = fams.setdefault(
                 name, {"help": "", "type": "", "samples": []}
             )
             merged["help"] = merged["help"] or parsed["help"]
             merged["type"] = merged["type"] or parsed["type"]
-            merged["samples"].extend(
-                _with_label(line, "agent", stem)
-                for line in parsed["samples"]
-            )
+            merged["samples"].extend(parsed["samples"])
+    with _AGG_CACHE_LOCK:
+        for stale in set(_AGG_CACHE) - set(paths):
+            del _AGG_CACHE[stale]
+    _metrics.get_registry().gauge(
+        "dlrover_metrics_aggregated_files",
+        "Agent textfile dumps folded into the last /metrics scrape",
+    ).set(merged_files)
     return _render_families(fams)
 
 

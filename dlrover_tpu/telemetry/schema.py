@@ -198,11 +198,23 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         # first_step); drain and reshard-restore are derived on the
         # assembled timeline from worker_restart/checkpoint_restore
         _s("resize_phase", ["phase", "seconds", "target"]),
-        # -- flight recorder (this PR) -------------------------------
+        # -- flight recorder -----------------------------------------
         _s("goodput_attribution", [
             "window_start", "window_end", "window_s", "training_s",
             "loss_s", "goodput", "buckets",
         ]),
+        # -- fleet observatory ---------------------------------------
+        # periodic control-plane scoreboard sample under synthetic
+        # fleet load: windowed per-verb latency view + fan-in gauges
+        # (open dict: the verbs payload varies with the traffic mix)
+        _s("fleet_report", ["agents", "rps", "window_s"],
+           allow_extra=True),
+        # SLO-green capacity search result: the max agent count one
+        # master sustained with every windowed rule green
+        _s("fleet_capacity",
+           ["max_sustained_agents"],
+           ["rps_at_capacity", "levels", "search_s",
+            "first_breach_agents"]),
     )
 }
 

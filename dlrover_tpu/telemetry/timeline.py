@@ -175,7 +175,8 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
             continue
         if etype in ("chaos_inject", "loss_spike",
                      "diagnosis_verdict", "hang_evidence",
-                     "rpc_slo_breach", "compile_cache", "aot_cache"):
+                     "rpc_slo_breach", "compile_cache", "aot_cache",
+                     "fleet_report", "fleet_capacity"):
             tl.instants.append(e)
             continue
         if etype == "recovery_phase":
@@ -777,6 +778,20 @@ def _describe_instant(e: Dict) -> str:
             f"load={_num(e.get('load_s')):.3f}s "
             f"trace={_num(e.get('trace_s')):.3f}s "
             f"wrote={bool(e.get('wrote'))}"
+        )
+    if etype == "fleet_report":
+        return (
+            f"{e.get('agents')} agents {_num(e.get('rps')):.0f} "
+            f"rps breaches={e.get('breaches', 0)} "
+            f"inflight={_num(e.get('inflight')):.0f} "
+            f"journal_p99={_num(e.get('journal_append_p99_ms')):.1f}"
+            "ms"
+        )
+    if etype == "fleet_capacity":
+        return (
+            f"max sustained {e.get('max_sustained_agents')} agents "
+            f"@ {_num(e.get('rps_at_capacity')):.0f} rps "
+            f"(first breach at {e.get('first_breach_agents')})"
         )
     return f"step={e.get('step')}"
 

@@ -1,0 +1,458 @@
+"""Fleet observatory: load-harness smoke + capacity search + the
+fan-in instrumentation and fixes it measures.
+
+The tier-1 smoke runs ~25 synthetic agents for a few seconds against
+one real journal-backed master and asserts the whole observation
+chain: scoreboard samples with per-verb windowed quantiles, SLO
+evaluation, schema-valid ``fleet_report`` events in the log, every
+production verb exercised (including forced-reconnect session
+resyncs), and zero agent-side errors.  The full multi-hundred ramp is
+marked ``slow``; the bench section reports the capacity number.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.fleet import AgentProfile, FleetRunner
+from dlrover_tpu.telemetry import metrics as tmetrics
+from dlrover_tpu.telemetry.events import read_events
+from dlrover_tpu.telemetry.schema import validate_event
+from dlrover_tpu.telemetry.slo import SloRule
+
+FAST_PROFILE = AgentProfile(
+    heartbeat_interval=0.3,
+    step_interval=0.2,
+    shard_interval=0.5,
+    kv_interval=1.0,
+    reconnect_prob=0.02,
+)
+
+
+@pytest.fixture
+def event_log(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("DLROVER_EVENT_LOG", str(path))
+    return path
+
+
+def test_fleet_smoke_tier1(tmp_path, event_log):
+    """~25 agents, a few seconds, one journal-backed master: the
+    acceptance smoke for the whole harness."""
+    runner = FleetRunner(
+        max_nodes=64,
+        profile=FAST_PROFILE,
+        workdir=str(tmp_path / "fleet"),
+        fsync_window_s=0.05,
+        scoreboard_interval_s=0.5,
+    )
+    try:
+        summary = runner.run_load(25, 3.0, settle_s=0.5)
+        stats = runner.stats()
+    finally:
+        runner.stop()
+
+    # scoreboard produced windowed samples with per-verb quantiles
+    assert summary["samples"] >= 3
+    assert summary["agents"] == 25
+    assert summary["mean_rps"] > 20
+    worst = summary["worst_p99_ms"]
+    for verb in (
+        "get.HeartbeatRequest",
+        "report.GlobalStepRecord",
+        "get.GetShardTaskRequest",
+        "report.ReportTaskResultRequest",
+    ):
+        assert verb in worst, f"{verb} missing from scoreboard"
+
+    # every production verb ran, resyncs fired, nothing errored
+    ops = stats["ops"]
+    for verb in (
+        "join", "heartbeat", "step", "shard_get", "shard_ack", "kv",
+    ):
+        assert ops.get(verb, 0) > 0, f"no {verb} ops"
+    assert stats["resyncs"] > 0, "fault mix never forced a resync"
+    assert stats["errors"] == {}, stats["errors"]
+
+    # SLO evaluation ran against the live histograms (the checker
+    # publishes its quantile gauge for every matched verb)
+    qg = tmetrics.get_registry().get("dlrover_rpc_quantile_seconds")
+    assert qg is not None and len(qg.collect()) > 0
+
+    # connection fan-in was visible
+    assert summary["conns_peak"] >= 25
+
+    # fleet_report events landed in the log and are schema-valid
+    reports = [
+        e for e in read_events(str(event_log))
+        if e.get("type") == "fleet_report"
+    ]
+    assert len(reports) >= 3
+    for e in reports:
+        assert validate_event(e) == [], validate_event(e)
+    assert any(e["agents"] == 25 for e in reports)
+
+
+def test_capacity_search_reports_green_levels(tmp_path, event_log):
+    """With generous rules every level is green: the search walks to
+    max_agents and reports it sustained."""
+    runner = FleetRunner(
+        max_nodes=16,
+        profile=FAST_PROFILE,
+        workdir=str(tmp_path / "fleet"),
+        fsync_window_s=0.05,
+        rules=[SloRule("get.*", 0.99, 30.0),
+               SloRule("report.*", 0.99, 30.0)],
+    )
+    try:
+        result = runner.capacity_search(
+            start=5, step=5, max_agents=10,
+            window_s=1.2, settle_s=0.3, deadline_s=60.0,
+        )
+    finally:
+        runner.stop()
+    assert result["max_sustained_agents"] == 10
+    assert result["first_breach"] is None
+    assert [lvl["agents"] for lvl in result["levels"]] == [5, 10]
+    assert all(lvl["green"] for lvl in result["levels"])
+    assert result["p99_at_capacity_ms"]
+    caps = [
+        e for e in read_events(str(event_log))
+        if e.get("type") == "fleet_capacity"
+    ]
+    assert len(caps) == 1
+    assert caps[0]["max_sustained_agents"] == 10
+    assert validate_event(caps[0]) == []
+
+
+def test_capacity_search_backs_off_on_breach(tmp_path):
+    """An impossible SLO breaches at the first level: the search
+    stops, reports the breach, and sustains nothing."""
+    runner = FleetRunner(
+        max_nodes=16,
+        profile=FAST_PROFILE,
+        workdir=str(tmp_path / "fleet"),
+        fsync_window_s=0.05,
+        rules=[SloRule("*", 0.5, 1e-9)],
+    )
+    try:
+        result = runner.capacity_search(
+            start=5, step=5, max_agents=10,
+            window_s=1.2, settle_s=0.3, deadline_s=60.0,
+        )
+    finally:
+        runner.stop()
+    assert result["max_sustained_agents"] == 0
+    assert result["first_breach"]["agents"] == 5
+    assert result["first_breach"]["breaches"]
+
+
+def test_step_piggyback_coalesces_rpcs(tmp_path, monkeypatch):
+    """With DLROVER_STEP_PIGGYBACK armed, a burst of step reports
+    costs ONE GlobalStepRecord RPC (the rest coalesce), the next
+    heartbeat carries the newest step, and the master's speed
+    monitor still sees it."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.master import JobMaster
+
+    monkeypatch.setenv("DLROVER_STEP_PIGGYBACK", "1")
+    monkeypatch.setenv("DLROVER_STEP_PIGGYBACK_WINDOW_S", "60")
+    master = JobMaster(port=0, node_num=4, job_name="pgy")
+    master.prepare()
+    try:
+        client = MasterClient(
+            f"127.0.0.1:{master.port}", node_id=0,
+            node_type="worker", node_rank=0, local_world_size=1,
+        )
+        hist = tmetrics.get_registry().get("dlrover_rpc_seconds")
+        before = hist.snapshot(
+            verb="report.GlobalStepRecord"
+        )["count"]
+        for step in range(1, 6):
+            client.report_global_step(step)
+        after = hist.snapshot(verb="report.GlobalStepRecord")["count"]
+        assert after - before == 1, (
+            "coalescing sent more than one direct step RPC"
+        )
+        # the master only saw the first direct send so far
+        assert master.speed_monitor.completed_global_step == 1
+        client.report_heartbeat()
+        assert master.speed_monitor.completed_global_step == 5, (
+            "heartbeat did not deliver the piggybacked step"
+        )
+        client.close()
+    finally:
+        master.stop()
+
+
+def test_max_conns_guard_rejects_cleanly():
+    """Over-limit connects get a typed RemoteError instead of a
+    silent thread pile-up; freeing a slot re-admits."""
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.comm import (
+        MessageClient,
+        MessageServer,
+        RemoteError,
+        RequestHandler,
+    )
+
+    class Echo(RequestHandler):
+        def report(self, node_id, node_type, m):
+            return True
+
+        def get(self, node_id, node_type, m):
+            return m
+
+    server = MessageServer(0, Echo(), max_conns=2)
+    server.start()
+    reg = tmetrics.get_registry()
+    rejected_before = reg.get(
+        "dlrover_master_conns_rejected_total"
+    ).value()
+    clients = [
+        MessageClient(f"127.0.0.1:{server.port}", retries=1)
+        for _ in range(3)
+    ]
+    try:
+        assert clients[0].get(msg.BaseRequest()) is not None
+        assert clients[1].get(msg.BaseRequest()) is not None
+        with pytest.raises(RemoteError, match="connection limit"):
+            clients[2].get(msg.BaseRequest())
+        assert reg.get(
+            "dlrover_master_conns_rejected_total"
+        ).value() == rejected_before + 1
+        # free a slot; a fresh client is admitted
+        clients[0].close()
+        time.sleep(0.3)
+        late = MessageClient(f"127.0.0.1:{server.port}", retries=1)
+        assert late.get(msg.BaseRequest()) is not None
+        late.close()
+    finally:
+        for c in clients:
+            c.close()
+        server.stop()
+
+
+def test_brain_data_drives_resize_decision(monkeypatch):
+    """ROADMAP item 1 acceptance: a ResizeCoordinator decision
+    sourced from Brain data — throughput history showing better
+    per-worker throughput at world=1 shrinks a healthy 2-node world
+    with a journaled 'brain:' decision."""
+    from dlrover_tpu.brain.datastore import SqliteJobMetricsStore
+    from dlrover_tpu.brain.service import BrainService, JobMetricRecord
+    from dlrover_tpu.common.constants import MasterAction, NodeType
+    from dlrover_tpu.master.auto_scaler import ResizeCoordinator
+    from dlrover_tpu.master.job_manager import JobManager
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+    monkeypatch.setenv("DLROVER_RESIZE_GRACE_S", "0")
+    rdzv = ElasticTrainingRendezvousManager()
+    rdzv.update_rdzv_params(min_nodes=1, max_nodes=2)
+    rdzv.join_rendezvous(0, 0, 1, "10.0.0.1")
+    rdzv.join_rendezvous(1, 1, 1, "10.0.0.2")
+    rdzv.get_comm_world(0)
+    jm = JobManager()
+    for node_id in (0, 1):
+        jm.add_node(NodeType.WORKER, node_id)
+        jm.collect_heartbeat(node_id)
+
+    class FakeServicer:
+        def __init__(self):
+            self.actions = {}
+
+        def request_node_action(self, node_id, action):
+            self.actions[node_id] = action
+
+    servicer = FakeServicer()
+    store = SqliteJobMetricsStore(":memory:")
+    # observed: 1 worker does 100 samples/s, 2 workers only 110 —
+    # per-worker throughput says the second node is near-worthless
+    for workers, sps in ((1, 100.0), (2, 110.0)):
+        for _ in range(3):
+            store.persist(JobMetricRecord(
+                job_name="j", timestamp=time.time(),
+                workers=workers, samples_per_sec=sps,
+            ))
+    coord = ResizeCoordinator(
+        rdzv, jm, SpeedMonitor(), servicer,
+        min_nodes=1, max_nodes=2,
+    )
+    coord.set_brain(
+        BrainService(store, job_name="j"), interval_s=1.0
+    )
+    coord._last_brain_poll = -1e9
+    coord.poll()
+    assert coord.pending is not None
+    assert coord.pending["target"] == 1
+    assert coord.pending["reason"].startswith("brain:")
+    # the decision drives the standard drain machinery
+    assert servicer.actions, "no drain actions delivered"
+    assert set(servicer.actions.values()) == {MasterAction.RESIZE}
+
+
+def test_brain_grow_beyond_capacity_deferred(monkeypatch):
+    """The Brain proposing more nodes than are alive must NOT start
+    a resize whose rendezvous can never complete."""
+    from dlrover_tpu.master.auto_scaler import ResizeCoordinator
+    from dlrover_tpu.master.job_manager import JobManager
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.resource_optimizer import ResourcePlan
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+    monkeypatch.setenv("DLROVER_RESIZE_GRACE_S", "1000")
+    rdzv = ElasticTrainingRendezvousManager()
+    rdzv.update_rdzv_params(min_nodes=1, max_nodes=1)
+    rdzv.join_rendezvous(0, 0, 1, "10.0.0.1")
+    rdzv.get_comm_world(0)
+    jm = JobManager()
+
+    class Brain:
+        def generate_worker_plan(self, current, speed):
+            return ResourcePlan(worker_count=4, comment="grow!")
+
+    class FakeServicer:
+        def request_node_action(self, node_id, action):
+            raise AssertionError("should not drain")
+
+    coord = ResizeCoordinator(
+        rdzv, jm, SpeedMonitor(), FakeServicer(),
+        min_nodes=1, max_nodes=4,
+    )
+    coord.set_brain(Brain(), interval_s=1.0)
+    coord._last_brain_poll = -1e9
+    coord.poll()
+    assert coord.pending is None
+
+
+def test_master_brain_auto_ingest(tmp_path, monkeypatch):
+    """The master run loop's Brain feed: maybe_brain_ingest ships
+    throughput snapshots + event-log diagnoses into the datastore on
+    a cadence (previously ingest_job_events was never called
+    automatically)."""
+    from dlrover_tpu.master.master import JobMaster
+
+    events = tmp_path / "events.jsonl"
+    t0 = time.time()
+    with open(events, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({
+                "schema": 1, "ts": t0 + i, "pid": 1,
+                "source": "trainer", "type": "train_step",
+                "step": i + 1, "restart_count": 0, "node_rank": 0,
+            }) + "\n")
+    monkeypatch.setenv("DLROVER_EVENT_LOG", str(events))
+    monkeypatch.setenv(
+        "DLROVER_BRAIN_DB", str(tmp_path / "brain.db")
+    )
+    monkeypatch.setenv("DLROVER_BRAIN_INGEST_INTERVAL_S", "0.01")
+    master = JobMaster(port=0, node_num=2, job_name="brainy")
+    try:
+        assert master.brain_store is not None
+        master.speed_monitor.collect_global_step(1, t0 + 1)
+        assert master.maybe_brain_ingest() is True
+        # cadence gate: an immediate second call is a no-op
+        master._brain_ingest_interval = 3600.0
+        assert master.maybe_brain_ingest() is False
+        rows = master.brain_store.load("brainy")
+        assert rows, "no rows ingested"
+        extras = master.brain_store.load_extras("brainy")
+        kinds = {e.get("event") for e in extras}
+        assert "throughput_snapshot" in kinds
+        assert "goodput_attribution" in kinds
+    finally:
+        master.stop()
+
+
+def test_aggregate_textfiles_mtime_cache(tmp_path, monkeypatch):
+    """Unchanged .prom dumps are served from the mtime/size cache
+    (no re-read, no re-parse); a modified dump is re-read; the
+    aggregated-file-count gauge tracks the fold."""
+    from dlrover_tpu.telemetry import exporter
+
+    a = tmp_path / "agent_a.prom"
+    b = tmp_path / "agent_b.prom"
+    a.write_text(
+        "# HELP m1 x\n# TYPE m1 counter\nm1 1\n"
+    )
+    b.write_text(
+        "# HELP m1 x\n# TYPE m1 counter\nm1 2\n"
+    )
+    pattern = str(tmp_path / "*.prom")
+
+    parses = {"n": 0}
+    real_parse = exporter._parse_families
+
+    def counting_parse(text):
+        parses["n"] += 1
+        return real_parse(text)
+
+    monkeypatch.setattr(
+        exporter, "_parse_families", counting_parse
+    )
+    exporter._AGG_CACHE.clear()
+    out1 = exporter.aggregate_textfiles(pattern)
+    assert 'agent="agent_a"' in out1 and 'agent="agent_b"' in out1
+    assert parses["n"] == 2
+    out2 = exporter.aggregate_textfiles(pattern)
+    assert parses["n"] == 2, "unchanged files were re-parsed"
+    assert out2 == out1
+    gauge = tmetrics.get_registry().get(
+        "dlrover_metrics_aggregated_files"
+    )
+    assert gauge.value() == 2
+    # a changed dump is re-read (different size forces a new key
+    # even on coarse-mtime filesystems)
+    a.write_text(
+        "# HELP m1 x\n# TYPE m1 counter\nm1 111\n"
+    )
+    out3 = exporter.aggregate_textfiles(pattern)
+    assert parses["n"] == 3
+    assert 'm1{agent="agent_a"} 111' in out3
+    # a vanished dump is pruned from cache and count
+    b.unlink()
+    exporter.aggregate_textfiles(pattern)
+    assert gauge.value() == 1
+    assert str(b) not in exporter._AGG_CACHE
+
+
+@pytest.mark.slow
+def test_fleet_full_ramp_200_agents(tmp_path, event_log):
+    """The headline claim at test scale: 200 synthetic agents
+    sustained SLO-green against one journal-backed master (the bench
+    section runs the full capacity search)."""
+    runner = FleetRunner(
+        max_nodes=512,
+        profile=AgentProfile(
+            heartbeat_interval=2.0,
+            step_interval=1.0,
+            shard_interval=4.0,
+            kv_interval=8.0,
+            reconnect_prob=0.002,
+        ),
+        workdir=str(tmp_path / "fleet"),
+        fsync_window_s=0.05,
+        piggyback=True,
+        # subprocess packs: in-process agent threads at this count
+        # would fight the master for the GIL and measure the
+        # harness, not the control plane
+        pack_size=50,
+    )
+    try:
+        level = runner._probe_level(200, window_s=8.0, settle_s=2.0)
+        stats = runner.stats()
+    finally:
+        runner.stop()
+    assert level["green"], level
+    assert stats["errors"] == {}
+    reports = [
+        e for e in read_events(str(event_log))
+        if e.get("type") == "fleet_report"
+    ]
+    assert any(e["agents"] == 200 for e in reports)
