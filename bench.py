@@ -28,6 +28,7 @@ so a hung section cannot contend with later timings), inside a
 """
 
 import json
+import math
 import os
 import pickle
 import shutil
@@ -1663,6 +1664,154 @@ for i in range(start_step, 10**9):
 '''
 
 
+def bench_serving(results: dict, workdir: str):
+    """Serving plane (ISSUE 13): the train-to-serve loop's three
+    headline numbers, measured in-process on host cores.
+
+    1. **Delta economics** — full-table export stall (the PR 9 path)
+       vs dirty-row delta export at the SAME table size after a ~2%
+       training interval: the stall must scale with rows touched,
+       not table size.
+    2. **Freshness** — train-commit -> servable latency through the
+       committed-generation protocol (publish + replica poll +
+       digest-verified apply), per generation over a 10-delta chain.
+    3. **Lookup p99 under concurrent ingest** — a reader thread
+       hammering the replica's host-gather path while generations
+       apply under the swap lock, vs the quiet baseline."""
+    import numpy as np
+
+    from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+    from dlrover_tpu.ops.kv_variable import KvVariable
+    from dlrover_tpu.serving import EmbeddingPublisher, ServingReplica
+
+    smoke = bool(os.getenv("BENCH_SMOKE"))
+    out: dict = {}
+    results["serving"] = out
+    rows = int(os.getenv(
+        "BENCH_SERVING_ROWS", "8000" if smoke else "200000"
+    ))
+    dim = 32
+    touch_frac = 0.02
+    rng = np.random.default_rng(0)
+    table = KvVariable(dim, initial_capacity=rows * 2, name="emb")
+    table.enable_dirty_tracking()
+    table.insert(
+        np.arange(rows, dtype=np.int64),
+        rng.normal(size=(rows, dim)).astype(np.float32),
+    )
+    adapter = SparseStateAdapter(digest=True).register_table(table)
+
+    # (1) export stall: full table vs dirty rows at the same size
+    t0 = time.perf_counter()
+    adapter.export_state()
+    full_s = time.perf_counter() - t0
+    table.clear_dirty()
+    touched = rng.choice(
+        rows, size=max(1, int(rows * touch_frac)), replace=False
+    ).astype(np.int64)
+    table.scatter_add(
+        touched,
+        rng.normal(size=(len(touched), dim)).astype(np.float32),
+    )
+    t0 = time.perf_counter()
+    delta = adapter.export_delta(clear=False)
+    delta_s = time.perf_counter() - t0
+    delta_rows = sum(
+        len(sub["keys"]) for sub in delta.values()
+        if isinstance(sub, dict) and "keys" in sub
+    )
+    out["table_rows"] = rows
+    out["full_export_s"] = round(full_s, 4)
+    out["delta_export_s"] = round(delta_s, 4)
+    out["delta_rows"] = int(delta_rows)
+    out["delta_ratio"] = round(delta_rows / rows, 4)
+    out["export_stall_speedup"] = round(
+        full_s / delta_s, 1
+    ) if delta_s > 0 else None
+
+    # (2+3) freshness + lookup tail under live ingest
+    serving_dir = os.path.join(workdir, "serving_bench")
+    pub = EmbeddingPublisher(
+        adapter, serving_dir, compact_every=64
+    )
+    pub.publish(step=0)
+    rep = ServingReplica(serving_dir)
+    rep.ingest_pending()
+
+    lookup_keys = [
+        rng.integers(0, rows, 512).astype(np.int64)
+        for _ in range(8)
+    ]
+
+    def _lookup_pass(samples, n):
+        for i in range(n):
+            t0 = time.perf_counter()
+            rep.lookup(lookup_keys[i % len(lookup_keys)])
+            samples.append(time.perf_counter() - t0)
+
+    quiet: list = []
+    _lookup_pass(quiet, 60 if smoke else 400)
+
+    stop = threading.Event()
+    busy: list = []
+
+    def reader():
+        while not stop.is_set():
+            _lookup_pass(busy, 20)
+
+    thread = threading.Thread(target=reader, daemon=True)
+    thread.start()
+    freshness: list = []
+    n_gens = 4 if smoke else 10
+    try:
+        for g in range(1, n_gens + 1):
+            touched = rng.choice(
+                rows, size=max(1, int(rows * touch_frac)),
+                replace=False,
+            ).astype(np.int64)
+            table.scatter_add(
+                touched,
+                rng.normal(
+                    size=(len(touched), dim)
+                ).astype(np.float32),
+            )
+            pub.publish(step=g)
+            commit_t = time.time()
+            # replica poll cadence is part of real freshness: poll at
+            # the production default-ish 100 ms until the generation
+            # lands
+            deadline = time.time() + 30
+            while (
+                rep.generation < pub.generation
+                and time.time() < deadline
+            ):
+                time.sleep(0.1)
+                rep.ingest_pending()
+            freshness.append(time.time() - commit_t)
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+
+    def _pct(samples, q):
+        return (
+            round(float(np.percentile(np.asarray(samples), q)) * 1e3, 3)
+            if samples else None
+        )
+
+    out["generations"] = n_gens
+    out["freshness_mean_s"] = round(
+        float(np.mean(freshness)), 4
+    ) if freshness else None
+    out["freshness_max_s"] = round(
+        float(np.max(freshness)), 4
+    ) if freshness else None
+    out["lookup_p50_quiet_ms"] = _pct(quiet, 50)
+    out["lookup_p99_quiet_ms"] = _pct(quiet, 99)
+    out["lookup_p50_under_ingest_ms"] = _pct(busy, 50)
+    out["lookup_p99_under_ingest_ms"] = _pct(busy, 99)
+    out["lookup_batches_under_ingest"] = len(busy)
+
+
 def bench_fleet_control_plane(results: dict, workdir: str):
     """Fleet observatory: the first capacity number of the project.
 
@@ -2336,6 +2485,18 @@ def _headline(snapshot: dict) -> dict:
         snapshot, "fleet_control_plane", "piggyback_rpc_ratio"
     )
     put("fleet_piggyback_rpc_ratio", ratio)
+    # serving plane: train-commit -> servable latency, lookup tail
+    # under live ingest, and the delta economics that bound the
+    # export stall by rows-touched instead of table size
+    put(
+        "serving_freshness_s",
+        _dig(snapshot, "serving", "freshness_mean_s"),
+    )
+    put(
+        "serving_lookup_p99_ms",
+        _dig(snapshot, "serving", "lookup_p99_under_ingest_ms"),
+    )
+    put("delta_ratio", _dig(snapshot, "serving", "delta_ratio"))
     put("flash_ckpt_stall_s", _dig(snapshot, "flash_ckpt", "flash_stall_s"))
     put(
         "flash_ckpt_restore_s",
@@ -2451,9 +2612,23 @@ def _headline(snapshot: dict) -> dict:
     partials = sorted(
         name for name, val in snapshot.items()
         if isinstance(val, dict) and val.get("partial")
+        # an errored section is already flagged under errors —
+        # repeating it here spent headline bytes on redundancy
+        and name not in errors
     )
     if partials:
         h["partial_sections"] = partials
+    # byte diet: three significant digits is more precision than any
+    # consumer of this line uses, and the raw floats (often 6+
+    # decimals from time.perf_counter math) were the single biggest
+    # contributor to the 1500-byte budget as sections accumulated
+    for key, val in h.items():
+        if isinstance(val, float) and val and math.isfinite(val):
+            digits = 2 - math.floor(math.log10(abs(val)))
+            val = round(val, max(0, digits))
+            if val == int(val):
+                val = int(val)
+            h[key] = val
     return h
 
 
@@ -2656,6 +2831,14 @@ def main() -> int:
             bench_fleet_control_plane(results, workdir)
         except Exception as e:  # noqa: BLE001
             results["fleet_error"] = f"{type(e).__name__}: {e}"
+        # serving is cheap (seconds) and pure-host: take it before
+        # the churn/recovery supervision trees add scheduler noise to
+        # the freshness / lookup-tail numbers
+        try:
+            bench_serving(results, workdir)
+            _emit(results, partial=True)
+        except Exception as e:  # noqa: BLE001
+            results["serving_error"] = f"{type(e).__name__}: {e}"
         try:
             bench_elastic_recovery(results, workdir)
         except Exception as e:  # noqa: BLE001

@@ -34,17 +34,69 @@ CREATE INDEX IF NOT EXISTS idx_job_name ON job_metrics (job_name);
 
 class SqliteJobMetricsStore:
     """Drop-in for :class:`~dlrover_tpu.brain.service.JobMetricsStore`
-    with real persistence + indexed queries."""
+    with real persistence + indexed queries.
 
-    def __init__(self, path: str = ":memory:"):
+    Multi-job safe: several masters (each its own process and
+    connection) can feed ONE datastore file concurrently — the Go
+    Brain's deployment shape.  Three things make that true: WAL mode
+    (readers never block the single writer, writers append to the
+    log instead of rewriting pages), a busy timeout so a write that
+    catches the WAL lock queues instead of throwing
+    ``database is locked``, and a bounded retry for the residual
+    SQLITE_BUSY cases a timeout cannot cover (two writers racing the
+    initial schema script, WAL checkpoint contention)."""
+
+    def __init__(self, path: str = ":memory:",
+                 busy_timeout_s: float = 10.0):
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, timeout=busy_timeout_s,
+        )
+        self._conn.execute(
+            f"PRAGMA busy_timeout = {int(busy_timeout_s * 1000)}"
+        )
+        if path != ":memory:":
+            # WAL only exists for file-backed databases; NORMAL
+            # durability pairs with it (fsync on checkpoint, not per
+            # commit) — metric rows are advisory, not control state
+            try:
+                self._retry(
+                    lambda: self._conn.execute(
+                        "PRAGMA journal_mode = WAL"
+                    )
+                )
+                self._conn.execute("PRAGMA synchronous = NORMAL")
+            except sqlite3.OperationalError:
+                pass  # stay on the rollback journal (still correct)
         with self._lock:
-            self._conn.executescript(_SCHEMA)
+            self._retry(lambda: self._conn.executescript(_SCHEMA))
             self._conn.commit()
 
+    def _retry(self, fn, attempts: int = 6, base_sleep: float = 0.05):
+        """Run ``fn`` through transient SQLITE_BUSY/LOCKED errors —
+        the shapes concurrent masters produce under checkpoint or
+        schema races that the busy timeout does not absorb.  The
+        open transaction is ROLLED BACK before each retry: a commit
+        that catches the lock leaves its INSERT pending on the
+        connection, and re-running fn() without the rollback would
+        commit the row twice."""
+        for i in range(attempts):
+            try:
+                return fn()
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if ("locked" not in msg and "busy" not in msg) or (
+                    i == attempts - 1
+                ):
+                    raise
+                try:
+                    self._conn.rollback()
+                except sqlite3.Error:
+                    pass
+                time.sleep(base_sleep * (2 ** i))
+
     def persist(self, record: JobMetricRecord, **extra):
-        with self._lock:
+        def _write():
             self._conn.execute(
                 "INSERT INTO job_metrics (job_name, timestamp, "
                 "workers, samples_per_sec, cpu_percent, memory_mb, "
@@ -64,6 +116,9 @@ class SqliteJobMetricsStore:
             )
             self._conn.commit()
 
+        with self._lock:
+            self._retry(_write)
+
     def load(
         self, job_name: Optional[str] = None
     ) -> List[JobMetricRecord]:
@@ -77,7 +132,9 @@ class SqliteJobMetricsStore:
             query += " WHERE job_name = ?"
             args = (job_name,)
         with self._lock:
-            rows = self._conn.execute(query, args).fetchall()
+            rows = self._retry(
+                lambda: self._conn.execute(query, args).fetchall()
+            )
         return [
             JobMetricRecord(
                 job_name=r[0], timestamp=r[1], workers=r[2] or 0,
@@ -103,7 +160,9 @@ class SqliteJobMetricsStore:
             query += " AND job_name = ?"
             args = (job_name,)
         with self._lock:
-            rows = self._conn.execute(query, args).fetchall()
+            rows = self._retry(
+                lambda: self._conn.execute(query, args).fetchall()
+            )
         out = []
         for job, ts, extra in rows:
             try:
@@ -116,9 +175,11 @@ class SqliteJobMetricsStore:
 
     def job_names(self) -> List[str]:
         with self._lock:
-            rows = self._conn.execute(
-                "SELECT DISTINCT job_name FROM job_metrics"
-            ).fetchall()
+            rows = self._retry(
+                lambda: self._conn.execute(
+                    "SELECT DISTINCT job_name FROM job_metrics"
+                ).fetchall()
+            )
         return [r[0] for r in rows]
 
     def close(self):

@@ -112,6 +112,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             total_steps=args.steps,
             max_restarts=args.max_restarts,
         )
+    elif scenario.name in (
+        "serving-replica-kill-midingest",
+        "serving-trainer-kill-midpublish",
+    ):
+        # needs the serving runner: the mini-cluster plus a
+        # supervised read-only replica subprocess ingesting the
+        # published generations under lookup traffic
+        report = harness.run_serving_scenario(
+            scenario,
+            workdir=workdir,
+            total_steps=args.steps,
+            max_restarts=args.max_restarts,
+        )
     elif nnodes > 1:
         report = harness.run_scenario_multinode(
             scenario,

@@ -29,6 +29,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -42,6 +43,7 @@ from dlrover_tpu.chaos.scenarios import (
     RUN_OPTIONS,
     SHARD_DATASET_ENV,
     SPARSE_RESIZE_TRAIN_SCRIPT,
+    SPARSE_SERVING_TRAIN_SCRIPT,
     SPARSE_TRAIN_SCRIPT,
     STEP_SLEEP_ENV,
     TOTAL_STEPS_ENV,
@@ -68,6 +70,7 @@ TRAIN_SCRIPTS = {
     "sparse": SPARSE_TRAIN_SCRIPT,
     "resize": RESIZE_TRAIN_SCRIPT,
     "sparse_resize": SPARSE_RESIZE_TRAIN_SCRIPT,
+    "sparse_serving": SPARSE_SERVING_TRAIN_SCRIPT,
 }
 
 
@@ -889,6 +892,170 @@ class KvReshardExactlyOnce(Invariant):
             self.name, True,
             f"{len(detail)} exactly-once reshard(s): "
             + "; ".join(detail),
+        )
+
+
+def _serving_events(events: List[dict], etype: str) -> List[dict]:
+    return [e for e in events if e.get("type") == etype]
+
+
+class ServedGenerationCommitted(Invariant):
+    """The replica never served a torn or uncommitted generation,
+    decided from events alone: every ``serving_ingest`` generation
+    has EXACTLY ONE matching committed ``serving_publish``, and the
+    per-table content digests the replica verified over what it
+    ACTUALLY applied equal the ones the publisher stamped at commit.
+    (The ingest event is emitted only after the full apply under the
+    swap lock, so a half-applied generation — e.g. a replica killed
+    mid-ingest — can never produce one.)"""
+
+    name = "served_generation_committed"
+
+    def check(self, events, run):
+        publishes = {}
+        for e in _serving_events(events, "serving_publish"):
+            publishes.setdefault(e.get("generation"), []).append(e)
+        ingests = _serving_events(events, "serving_ingest")
+        if not ingests:
+            return InvariantResult(
+                self.name, False, "no serving_ingest events recorded"
+            )
+        problems = []
+        for e in ingests:
+            gen = e.get("generation")
+            pubs = publishes.get(gen)
+            if not pubs:
+                problems.append(
+                    f"gen {gen} ingested but never published"
+                )
+                continue
+            want = pubs[-1].get("tables") or {}
+            got = e.get("tables") or {}
+            if want != got:
+                problems.append(
+                    f"gen {gen} digest mismatch: published {want} != "
+                    f"ingested {got}"
+                )
+        if problems:
+            return InvariantResult(
+                self.name, False, "; ".join(problems[:4])
+            )
+        gens = sorted({e.get("generation") for e in ingests})
+        return InvariantResult(
+            self.name, True,
+            f"{len(ingests)} ingest(s) over generation(s) "
+            f"{gens[0]}..{gens[-1]}, every digest matches its commit",
+        )
+
+
+class PublishExactlyOnce(Invariant):
+    """Every committed generation was published exactly once
+    (``serving_publish`` is emitted after the tracker advance): no
+    generation number repeats, and the sequence is monotonic — the
+    trainer killed mid-publish left its half-written generation
+    uncommitted and its replacement moved on to a fresh number."""
+
+    name = "publish_exactly_once"
+
+    def check(self, events, run):
+        pubs = _serving_events(events, "serving_publish")
+        if not pubs:
+            return InvariantResult(
+                self.name, False, "no serving_publish events recorded"
+            )
+        gens = [e.get("generation") for e in pubs]
+        dupes = sorted({g for g in gens if gens.count(g) > 1})
+        if dupes:
+            return InvariantResult(
+                self.name, False,
+                f"generation(s) {dupes} published more than once",
+            )
+        if gens != sorted(gens):
+            return InvariantResult(
+                self.name, False,
+                f"publish sequence not monotonic: {gens}",
+            )
+        bases = sum(1 for e in pubs if e.get("kind") == "base")
+        return InvariantResult(
+            self.name, True,
+            f"{len(gens)} generation(s) ({bases} base), each "
+            "committed exactly once",
+        )
+
+
+class ServingConverged(Invariant):
+    """The replica caught up: the LAST committed generation (highest
+    ``serving_publish``) was ingested — freshness converges to zero
+    lag after the chaos settles."""
+
+    name = "serving_converged"
+
+    def check(self, events, run):
+        pubs = _serving_events(events, "serving_publish")
+        ingests = _serving_events(events, "serving_ingest")
+        if not pubs or not ingests:
+            return InvariantResult(
+                self.name, False,
+                f"{len(pubs)} publish / {len(ingests)} ingest "
+                "event(s)",
+            )
+        last_pub = max(e.get("generation") for e in pubs)
+        got = {e.get("generation") for e in ingests}
+        if last_pub not in got:
+            return InvariantResult(
+                self.name, False,
+                f"final committed generation {last_pub} never "
+                f"ingested (replica reached {max(got)})",
+            )
+        fresh = [
+            e.get("freshness_s") for e in ingests
+            if e.get("generation") == last_pub
+            and isinstance(e.get("freshness_s"), (int, float))
+        ]
+        tail = f" (freshness {fresh[-1]:.3f}s)" if fresh else ""
+        return InvariantResult(
+            self.name, True,
+            f"replica converged on generation {last_pub}{tail}",
+        )
+
+
+class ReplicaReingested(Invariant):
+    """After the fault, a RESPAWNED replica re-ingested from
+    committed state: some post-fault ``serving_ingest`` carries
+    ``respawned`` and the respawn's first ingest is a BASE (a fresh
+    replica cannot apply a delta onto nothing — re-basing is the
+    recovery path under test)."""
+
+    name = "replica_reingested"
+
+    def check(self, events, run):
+        fault_ts = _first_fault_ts(events)
+        if fault_ts is None:
+            return InvariantResult(
+                self.name, False, "no chaos_inject event recorded"
+            )
+        post = [
+            e for e in _serving_events(events, "serving_ingest")
+            if e.get("respawned") and e["ts"] >= fault_ts
+        ]
+        if not post:
+            return InvariantResult(
+                self.name, False,
+                "no post-fault ingest from a respawned replica",
+            )
+        first = post[0]
+        if first.get("kind") != "base":
+            return InvariantResult(
+                self.name, False,
+                f"respawned replica's first ingest was a "
+                f"{first.get('kind')!r} (gen {first.get('generation')}"
+                "), not a re-base",
+            )
+        return InvariantResult(
+            self.name, True,
+            f"respawned replica re-based at generation "
+            f"{first.get('generation')} and applied {len(post)} "
+            "generation(s)",
         )
 
 
@@ -1815,6 +1982,40 @@ def invariants_for_scenario(
             TrainingCompleted(total_steps=total_steps),
             NoOrphanProcesses(marker=workdir),
         ]
+    if name == "serving-replica-kill-midingest":
+        # the trainer is undisturbed (completion only); the serving
+        # assertions carry the scenario: every served generation was
+        # committed with matching digests (no torn serve), committed
+        # exactly once, the respawned replica re-based from committed
+        # state, and the replica converged on the final generation
+        return [
+            TrainingCompleted(total_steps=total_steps),
+            ServedGenerationCommitted(),
+            PublishExactlyOnce(),
+            ReplicaReingested(),
+            ServingConverged(),
+            NoOrphanProcesses(marker=workdir),
+        ]
+    if name == "serving-trainer-kill-midpublish":
+        # the data-plane recovery trail (the kill lands mid-step) PLUS
+        # publish exactly-once across the trainer replacement: the
+        # half-published generation never committed, the replacement
+        # re-based at a fresh number, the replica kept serving and
+        # converged — and the restored trainer's loss trajectory still
+        # equals the uninterrupted control (publishing is side-effect-
+        # free for training)
+        return [
+            WorkerRestarted(),
+            BoundedStepLoss(ckpt_interval=ckpt_every),
+            TrainingCompleted(total_steps=total_steps),
+            LossTrajectoryMatches(
+                sparse_reference_losses(total_steps)
+            ),
+            ServedGenerationCommitted(),
+            PublishExactlyOnce(),
+            ServingConverged(),
+            NoOrphanProcesses(marker=workdir),
+        ]
     if name in RECOVERY_SCENARIOS:
         return default_invariants(total_steps, ckpt_every, workdir)
     return [
@@ -1929,6 +2130,169 @@ def run_scenario(
         else invariants_for_scenario(
             scenario.name, total_steps, ckpt_every, workdir,
             disk_every=disk_every,
+        )
+    )
+    for inv in checks:
+        try:
+            report.invariants.append(
+                inv.check(report.events, report)
+            )
+        except Exception as e:  # noqa: BLE001 - a checker bug is a FAIL
+            logger.exception("invariant %s crashed", inv.name)
+            report.invariants.append(
+                InvariantResult(inv.name, False, f"checker crashed: {e}")
+            )
+    return report
+
+
+def run_serving_scenario(
+    scenario,
+    workdir: str,
+    total_steps: Optional[int] = None,
+    max_replica_respawns: int = 1,
+    replica_lookup_batch: int = 256,
+    converge_timeout_s: float = 20.0,
+    invariants: Optional[List[Invariant]] = None,
+    **kwargs,
+) -> ChaosRunReport:
+    """Run a train-to-serve scenario: the single-node mini-cluster
+    (trainer publishing serving generations) PLUS a supervised
+    read-only replica subprocess (``python -m dlrover_tpu.serving``)
+    ingesting them while driving lookup traffic.
+
+    The replica gets its OWN event log (merged into the report like
+    an agent-shipped log) and the scenario spec via ``DLROVER_CHAOS``
+    — rules targeting it select on ``DLROVER_SERVING_ROLE=replica``.
+    A replica that dies is respawned up to ``max_replica_respawns``
+    times with ``DLROVER_SERVING_RESPAWNED=1`` (the schedule's
+    env-equals guard against re-firing, and the ``respawned`` stamp
+    on its events).  After training finishes the runner waits for the
+    replica to converge on the final committed generation, then stops
+    it via the stop file before the orphan scan runs."""
+    scenario = load_scenario(scenario)
+    opts = RUN_OPTIONS.get(scenario.name, {})
+    os.makedirs(workdir, exist_ok=True)
+    serving_dir = os.path.join(workdir, "serving")
+    spec_path = os.path.join(workdir, "chaos_scenario.json")
+    with open(spec_path, "w") as f:
+        json.dump(scenario.to_dict(), f, indent=2)
+    replica_log = os.path.join(workdir, "serving_events.jsonl")
+    stop_file = os.path.join(workdir, "serving_stop")
+
+    replica_env = dict(os.environ)
+    replica_env.update(opts.get("extra_env", {}))
+    replica_env.update({
+        _chaos.CHAOS_ENV: spec_path,
+        EVENT_LOG_ENV: replica_log,
+        "DLROVER_SERVING_ROLE": "replica",
+        "DLROVER_SERVING_RESPAWNED": "",
+        # the replica needs no master and must not inherit one
+        "DLROVER_MASTER_ADDR": "",
+    })
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.serving",
+        "--dir", serving_dir,
+        "--poll", "0.1",
+        "--batch", str(replica_lookup_batch),
+        "--key-space", "4000",
+        "--stats-every", "0.5",
+        "--stop-file", stop_file,
+    ]
+    state = {"proc": None, "respawns": 0, "stopping": False}
+
+    def _spawn(respawned: bool):
+        env = dict(replica_env)
+        if respawned:
+            env["DLROVER_SERVING_RESPAWNED"] = "1"
+        state["proc"] = subprocess.Popen(  # noqa: S603
+            cmd, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def _supervise():
+        while not state["stopping"]:
+            proc = state["proc"]
+            if proc is None:
+                return
+            rc = proc.wait()
+            if state["stopping"] or rc == 0:
+                return
+            if state["respawns"] >= max_replica_respawns:
+                logger.warning(
+                    "serving replica died rc=%s with no respawn "
+                    "budget left", rc,
+                )
+                return
+            state["respawns"] += 1
+            logger.warning(
+                "serving replica died rc=%s; respawning (%d/%d)",
+                rc, state["respawns"], max_replica_respawns,
+            )
+            _spawn(respawned=True)
+
+    _spawn(respawned=False)
+    supervisor = threading.Thread(
+        target=_supervise, daemon=True, name="serving-replica-sup"
+    )
+    supervisor.start()
+
+    try:
+        base = run_scenario(
+            scenario, workdir,
+            total_steps=total_steps,
+            invariants=[],
+            extra_env={"DLROVER_SERVING_DIR": serving_dir},
+            **kwargs,
+        )
+        # let the replica converge on the final committed generation
+        # before stopping it (the freshness the invariants assert)
+        from dlrover_tpu.serving.publisher import (
+            committed_generation,
+        )
+
+        deadline = time.time() + converge_timeout_s
+        target = committed_generation(serving_dir)
+        while time.time() < deadline and target > 0:
+            try:
+                ingested = {
+                    e.get("generation")
+                    for e in collect_events([replica_log])
+                    if e.get("type") == "serving_ingest"
+                }
+            except OSError:
+                ingested = set()
+            if target in ingested:
+                break
+            time.sleep(0.25)
+    finally:
+        state["stopping"] = True
+        with open(stop_file, "w") as f:
+            f.write("stop")
+        proc = state["proc"]
+        if proc is not None:
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        supervisor.join(timeout=5.0)
+
+    report = _build_report(
+        scenario, base.rc, workdir, base.event_log,
+        extra_sources=[replica_log],
+    )
+    resolved_steps = total_steps if total_steps is not None else int(
+        opts.get("total_steps", 10)
+    )
+    checks = (
+        invariants if invariants is not None
+        else invariants_for_scenario(
+            scenario.name, resolved_steps,
+            int(opts.get("ckpt_every", 2)), workdir,
         )
     )
     for inv in checks:

@@ -609,6 +609,140 @@ def sparse_reference_losses(total_steps: int):
     return out
 
 
+# Train-to-serve loop: the sparse DeepFM loop PLUS an
+# EmbeddingPublisher shipping the embedding table to a serving
+# replica as committed base/delta generations every
+# DLROVER_CHAOS_PUB_EVERY steps.  A fresh incarnation's publisher
+# always opens with a base at a NEW generation (it cannot know what a
+# dead predecessor half-published), which is what makes the
+# trainer-kill-mid-publish scenario's recovery exactly-once by
+# construction.  argv: ckpt_dir; serving dir from
+# DLROVER_SERVING_DIR (harness) or <workdir>/serving.
+SPARSE_SERVING_TRAIN_SCRIPT = r'''
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.checkpoint.checkpointer import (
+    Checkpointer, StorageType, restore_to_template,
+)
+from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+from dlrover_tpu.serving import EmbeddingPublisher
+from dlrover_tpu.trainer.sparse_pipeline import make_deepfm_device_step
+from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+ckpt_dir = sys.argv[1]
+TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "12"))
+CKPT_EVERY = int(os.environ.get("DLROVER_CHAOS_CKPT_EVERY", "2"))
+PUB_EVERY = int(os.environ.get("DLROVER_CHAOS_PUB_EVERY", "2"))
+COMPACT_EVERY = int(os.environ.get("DLROVER_CHAOS_COMPACT_EVERY", "4"))
+STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
+serving_dir = os.environ.get("DLROVER_SERVING_DIR") or os.path.join(
+    os.path.dirname(ckpt_dir), "serving"
+)
+
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+
+def committed_step():
+    try:
+        with open(tracker) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
+
+# MUST mirror scenarios.sparse_reference_losses exactly
+cfg = DeepFMConfig(num_sparse_fields=6, num_dense_features=4,
+                   embedding_dim=8, hidden_dims=(16,), seed=5)
+model = DeepFM(cfg)
+
+dense_opt = optax.adam(1e-2)
+adapter = SparseStateAdapter()
+adapter.register_optimizer(model.sparse_optimizer)
+ckpt = Checkpointer(ckpt_dir)
+ckpt.register_sparse(adapter)
+
+# serving publishes ONLY the embedding table (replicas have no use
+# for optimizer moments); its own adapter shares the table object, so
+# dirty tracking is one truth for both planes
+serving_adapter = SparseStateAdapter().register_table(model.table)
+publisher = EmbeddingPublisher(
+    serving_adapter, serving_dir, compact_every=COMPACT_EVERY,
+)
+
+params = model.init_dense_params()
+opt_state = dense_opt.init(params)
+start_step, restored = ckpt.load_checkpoint()
+if start_step is None:
+    start_step = 0
+else:
+    params, opt_state = restore_to_template(
+        (params, opt_state), restored["dense"]
+    )
+state = (params, opt_state)
+device_step = make_deepfm_device_step(model, dense_opt)
+
+trainer = ElasticTrainer(global_batch_size=16, micro_batch_size=16,
+                         dp_size=1)
+trainer.global_step = start_step
+
+def batch_for(k):
+    rng = np.random.default_rng(10_000 + k)
+    sparse = rng.integers(
+        0, 4000, (16, cfg.num_sparse_fields)
+    ).astype(np.int64)
+    dense = rng.normal(
+        size=(16, cfg.num_dense_features)
+    ).astype(np.float32)
+    labels = (sparse[:, 0] % 2).astype(np.float32)
+    return sparse, dense, labels
+
+for k in range(start_step, TOTAL_STEPS):
+    sparse_ids, dense_x, labels = batch_for(k)
+    with trainer.profile("h2d"):
+        emb = jnp.asarray(model.gather_embeddings(sparse_ids))
+        dx, lb = jnp.asarray(dense_x), jnp.asarray(labels)
+    with trainer.profile("compute") as p:
+        state, egrads, aux = device_step(state, emb, dx, lb)
+        p.block(aux["loss"])
+    model.apply_sparse_gradients(sparse_ids, np.asarray(egrads))
+    trainer.report_step({"loss": float(aux["loss"])})
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+    with trainer.profile("checkpoint"):
+        if trainer.global_step % CKPT_EVERY == 0:
+            ckpt.save_checkpoint(
+                trainer.global_step,
+                {"dense": state, "trainer": trainer.state_dict()},
+                storage_type=StorageType.MEMORY,
+            )
+    if trainer.global_step % PUB_EVERY == 0:
+        publisher.publish(step=trainer.global_step)
+
+# final publish so the replica can converge on the last trained state
+if publisher.generation == 0 or TOTAL_STEPS % PUB_EVERY != 0:
+    publisher.publish(step=TOTAL_STEPS)
+
+final_sd = {"dense": state, "trainer": trainer.state_dict()}
+deadline = time.time() + 60
+while time.time() < deadline and committed_step() < TOTAL_STEPS:
+    ckpt.save_checkpoint(
+        TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+    )
+    ckpt.wait()
+    poll_end = time.time() + 10
+    while time.time() < poll_end and committed_step() < TOTAL_STEPS:
+        time.sleep(0.2)
+assert committed_step() >= TOTAL_STEPS, (
+    "checkpoint commit did not land"
+)
+ckpt.close()
+'''
+
+
 # Sparse elastic world-resize loop: RESIZE_TRAIN_SCRIPT's GSPMD dense
 # leg (lockstep collectives, loss == the uninterrupted control at any
 # world size) PLUS a KvVariable embedding partitioned across the
@@ -1271,6 +1405,56 @@ def sparse_resize_churn(seed: int = 71) -> Scenario:
     })
 
 
+def serving_replica_kill_midingest(seed: int = 83) -> Scenario:
+    """Serving-plane replica recovery (ISSUE 13): SIGKILL the serving
+    replica INSIDE a generation apply (the ``serving.ingest`` hook
+    fires under the swap lock, tables half-applied).  The harness
+    respawns it; the fresh replica re-ingests from the newest
+    committed base and converges on the trainer's final generation.
+    The digest chain on ``serving_ingest`` vs ``serving_publish``
+    events proves no torn generation was ever served — the
+    half-applied state died with the process and no event claimed
+    it."""
+    return Scenario.from_dict({
+        "name": "serving-replica-kill-midingest",
+        "seed": seed,
+        "rules": [{
+            "name": "kill-replica-midingest",
+            "point": "serving.ingest",
+            "action": "kill",
+            "after_calls": 3,
+            "max_count": 1,
+            "env_equals": {
+                "DLROVER_SERVING_ROLE": "replica",
+                "DLROVER_SERVING_RESPAWNED": "",
+            },
+        }],
+    })
+
+
+def serving_trainer_kill_midpublish(seed: int = 89) -> Scenario:
+    """Serving-plane publisher exactly-once (ISSUE 13): SIGKILL the
+    trainer between writing a generation's blobs/manifest and its
+    ``DONE`` marker (the ``serving.publish`` hook sits exactly
+    there).  The half-published generation is never committed — the
+    replica keeps serving the previous one — and the respawned
+    trainer's publisher opens with a fresh BASE at the next
+    generation number: every committed generation is published
+    exactly once, provable by counting ``serving_publish`` events."""
+    return Scenario.from_dict({
+        "name": "serving-trainer-kill-midpublish",
+        "seed": seed,
+        "rules": [{
+            "name": "kill-trainer-midpublish",
+            "point": "serving.publish",
+            "action": "kill",
+            "after_calls": 3,
+            "max_count": 1,
+            "only_first_incarnation": True,
+        }],
+    })
+
+
 def warm_recovery_cache_hit(seed: int = 73) -> Scenario:
     """Invisible-recovery acceptance (ISSUE 10): SIGKILL the worker
     mid-run under warm restarts + the job-keyed persistent compile
@@ -1354,6 +1538,10 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "sparse_kill_restore": sparse_kill_restore,
     "sparse_spill_io_error": sparse_spill_io_error,
     "sparse_resize_churn": sparse_resize_churn,
+    "serving_replica_kill_midingest": serving_replica_kill_midingest,
+    "serving_trainer_kill_midpublish": (
+        serving_trainer_kill_midpublish
+    ),
     "warm_recovery_cache_hit": warm_recovery_cache_hit,
     "master_respawn_other_host": master_respawn_other_host,
 }
@@ -1472,6 +1660,37 @@ RUN_OPTIONS: Dict[str, Dict] = {
         "extra_env": {
             "DLROVER_KV_DIGEST": "1",
             "DLROVER_CHAOS_KV_SPILL": "48",
+        },
+    },
+    # serving plane: the sparse loop + publisher shipping the
+    # embedding table every 2 steps (digests armed — manifests and
+    # the torn-serve invariants need them); the serving runner reads
+    # train_script="sparse_serving" and supervises the replica
+    # subprocess itself
+    "serving-replica-kill-midingest": {
+        "total_steps": 12,
+        "ckpt_every": 2,
+        "train_script": "sparse_serving",
+        "extra_env": {
+            "DLROVER_KV_DIGEST": "1",
+            "DLROVER_CHAOS_PUB_EVERY": "2",
+            # slow the loop slightly so several generations commit
+            # while the replica is alive on a loaded CI box
+            "DLROVER_CHAOS_STEP_SLEEP": "0.2",
+        },
+    },
+    # ckpt_every=4 vs publish-every-2: the kill (3rd publish = step
+    # 6) restores the step-4 snapshot and REPLAYS steps 5-6, so the
+    # loss-trajectory invariant's multi-incarnation cross-check has
+    # real replayed steps to agree on
+    "serving-trainer-kill-midpublish": {
+        "total_steps": 12,
+        "ckpt_every": 4,
+        "train_script": "sparse_serving",
+        "extra_env": {
+            "DLROVER_KV_DIGEST": "1",
+            "DLROVER_CHAOS_PUB_EVERY": "2",
+            "DLROVER_CHAOS_STEP_SLEEP": "0.2",
         },
     },
     # spill-disk death mid-export: same loop + budget; the kill lands

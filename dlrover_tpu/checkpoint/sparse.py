@@ -124,6 +124,17 @@ def rows_digest(
     return int(total)
 
 
+def keys_digest(keys: np.ndarray) -> int:
+    """Order-independent digest of a bare key set (deletion
+    tombstones carry no values): sum mod 2**64 of the per-key
+    splitmix hashes.  Same additivity contract as
+    :func:`rows_digest`."""
+    if np.asarray(keys).size == 0:
+        return 0
+    with np.errstate(over="ignore"):
+        return int(np.sum(_hash64(keys), dtype=np.uint64))
+
+
 def _digest_enabled() -> bool:
     return os.environ.get(
         "DLROVER_KV_DIGEST", ""
@@ -262,6 +273,166 @@ class SparseStateAdapter:
             event["digests"] = digests
         emit_event("kv_checkpoint", **event)
         return out
+
+    # -- delta export (serving-plane incremental publication) ---------------
+
+    def enable_dirty_tracking(self) -> "SparseStateAdapter":
+        """Arm dirty/dead tracking on every registered table (the
+        serving publisher calls this at construction — tracking is
+        opt-in so non-publishing jobs pay nothing)."""
+        for table in self._tables.values():
+            table.enable_dirty_tracking()
+        return self
+
+    def dirty_rows(self) -> int:
+        """Rows the next delta would carry, summed over tables."""
+        return sum(t.dirty_count() for t in self._tables.values())
+
+    def export_delta(
+        self, step: Optional[int] = None, rank: Optional[int] = None,
+        clear: bool = True,
+    ) -> Dict[str, Any]:
+        """Snapshot only the rows TOUCHED since the last cleared
+        delta (plus deletion tombstones) — the export stall is
+        O(rows touched this interval), never O(table), which is what
+        lets a multi-GB continuously-trained table republish to
+        serving replicas without full-table stalls (reference:
+        tfplus ``checkpoint_manager.py:72`` delta checkpoints).
+
+        ``clear`` (the publisher default) atomically drains exactly
+        the exported keys, so a mutation racing the export lands in
+        the NEXT delta instead of vanishing.  Flash checkpoints call
+        :meth:`export_state` and never clear — the serving delta
+        chain and the fault-tolerance snapshots baseline
+        independently."""
+        t0 = time.perf_counter()
+        with_digest = self.digest_enabled()
+        out: Dict[str, Any] = {}
+        digests: Dict[str, Dict[str, Any]] = {}
+        rows = nbytes = dead_rows = table_rows = 0
+        for name, table in self._tables.items():
+            # tombstones FIRST: the two exports are separate lock
+            # holds, and an eviction landing between them must not
+            # put a key in this delta's tombstones AFTER its row was
+            # exported (apply would delete-then-reimport — a
+            # resurrection).  Dead-first, the racing eviction's
+            # tombstone simply waits for the next delta; dead-THEN-
+            # re-touched keys legitimately appear in both lists and
+            # the apply order (delete, then import) lands them alive
+            # with the new value — same as the trainer.
+            dead = table.export_dead(clear=clear)
+            keys, values, freq = table.export_dirty(clear=clear)
+            out[name] = {
+                "keys": keys, "values": values, "freq": freq,
+                "dead": dead,
+            }
+            rows += len(keys)
+            dead_rows += len(dead)
+            table_rows += len(table)
+            nbytes += (
+                keys.nbytes + values.nbytes + freq.nbytes + dead.nbytes
+            )
+            if with_digest:
+                digests[name] = {
+                    "rows": int(len(keys)),
+                    "sum": f"{rows_digest(keys, values, freq):016x}",
+                    "dead": int(len(dead)),
+                    "dead_sum": f"{keys_digest(dead):016x}",
+                }
+        scalars = {
+            _enc(opt.table.name): opt.state_scalars()
+            for opt in self._optimizers
+            if hasattr(opt, "state_scalars")
+        }
+        if scalars:
+            out[SCALARS_KEY] = scalars
+        seconds = time.perf_counter() - t0
+        _KV_CKPT_SECONDS.observe(seconds, stage="export_delta")
+        event = dict(
+            stage="export", rows=int(rows), bytes=int(nbytes),
+            seconds=round(seconds, 4), tables=len(self._tables),
+            delta=True, dead_rows=int(dead_rows),
+            table_rows=int(table_rows),
+        )
+        if step is not None:
+            event["step"] = int(step)
+        if rank is not None:
+            event["rank"] = int(rank)
+        if digests:
+            event["digests"] = digests
+        emit_event("kv_checkpoint", **event)
+        return out
+
+    def apply_delta(
+        self, state: Dict, tier: str = "", step: Optional[int] = None,
+        rank: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Apply one delta onto the registered tables IN PLACE:
+        tombstoned keys are deleted, touched rows imported (insert or
+        overwrite) — the replica-side half of the delta chain, and
+        the replay primitive the compaction-edge tests drive.  Unlike
+        :meth:`import_state` this never clears: unchanged rows stay
+        put."""
+        t0 = time.perf_counter()
+        with_digest = self.digest_enabled()
+        rows = nbytes = dead_rows = 0
+        digests: Dict[str, Dict[str, Any]] = {}
+        for name, table in self._tables.items():
+            sub = state.get(name)
+            if not isinstance(sub, dict) or "keys" not in sub:
+                continue
+            keys = np.ascontiguousarray(sub["keys"], dtype=np.int64)
+            values = np.ascontiguousarray(
+                sub["values"], dtype=np.float32
+            )
+            freq = np.ascontiguousarray(sub["freq"], dtype=np.uint64)
+            dead = np.ascontiguousarray(
+                sub.get("dead", ()), dtype=np.int64
+            )
+            # tombstones first — LOAD-BEARING: the exporter reads
+            # dead before dirty, so a key that died and was
+            # re-touched between the two exports appears in both
+            # lists, and delete-then-import must land it alive with
+            # the re-touched value (matching the trainer's state)
+            if dead.size:
+                table.delete(dead)
+            if keys.size:
+                table.import_(keys, values, freq)
+            rows += int(keys.size)
+            dead_rows += int(dead.size)
+            nbytes += (
+                keys.nbytes + values.nbytes + freq.nbytes + dead.nbytes
+            )
+            if with_digest:
+                digests[name] = {
+                    "rows": int(keys.size),
+                    "sum": f"{rows_digest(keys, values, freq):016x}",
+                    "dead": int(dead.size),
+                    "dead_sum": f"{keys_digest(dead):016x}",
+                }
+        scalars = state.get(SCALARS_KEY)
+        if scalars:
+            for opt in self._optimizers:
+                sc = scalars.get(_enc(opt.table.name))
+                if sc and hasattr(opt, "load_state_scalars"):
+                    opt.load_state_scalars(sc)
+        seconds = time.perf_counter() - t0
+        _KV_CKPT_SECONDS.observe(seconds, stage="apply_delta")
+        event = dict(
+            stage="restore", rows=int(rows), bytes=int(nbytes),
+            seconds=round(seconds, 4), tables=len(self._tables),
+            resharded=False, delta=True, dead_rows=int(dead_rows),
+        )
+        if tier:
+            event["tier"] = tier
+        if step is not None:
+            event["step"] = int(step)
+        if rank is not None:
+            event["rank"] = int(rank)
+        if digests:
+            event["digests"] = digests
+        emit_event("kv_checkpoint", **event)
+        return {"kv_s": round(seconds, 4), "kv_rows": int(rows)}
 
     # -- import (restore path) ----------------------------------------------
 

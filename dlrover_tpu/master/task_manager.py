@@ -12,7 +12,7 @@ import json
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common.constants import TaskType
@@ -614,6 +614,44 @@ class TaskManager:
                     "(journal mirror lag)", dataset_name, task_id,
                 )
             return changed
+
+    def reconcile_acked_tasks(
+        self, pairs: List[Tuple[str, int]]
+    ) -> int:
+        """Batched session-resync reconciliation: close every lease
+        in ``pairs`` ((dataset, task_id) tuples — the agent's whole
+        recent-ack history) and journal the changed ones with ONE
+        multi-record append.  The per-ack flavour journaled each
+        reconcile individually: a 64-ack resync did up to 64
+        sequential appends under the journal io lock — the first
+        control-plane SLO breach at 250 fleet agents.  Returns how
+        many leases actually changed."""
+        changed: List[Tuple[str, int]] = []
+        with self._lock:
+            for dataset_name, task_id in pairs:
+                if task_id < 0 or not dataset_name:
+                    continue
+                ds = self._datasets.get(dataset_name)
+                if ds is None:
+                    continue
+                if ds.reconcile_acked(task_id):
+                    changed.append((dataset_name, task_id))
+            if changed and self.journal is not None:
+                self.journal.append_many([
+                    (
+                        "ack_reconciled",
+                        {"dataset": d, "task_id": t},
+                    )
+                    for d, t in changed
+                ])
+        if changed:
+            logger.warning(
+                "resync reconciled %d lost ack(s) in one journal "
+                "batch: %s (journal mirror lag)",
+                len(changed),
+                ", ".join(f"{d}#{t}" for d, t in changed[:8]),
+            )
+        return len(changed)
 
     def requeue_unacked(self) -> int:
         """Recovery epilogue: return every un-acked lease to the

@@ -106,6 +106,25 @@ def _load():
         ]
         lib.kv_clear.argtypes = [ctypes.c_void_p]
         lib.kv_spill_break.argtypes = [ctypes.c_void_p]
+        lib.kv_dirty_enable.argtypes = [ctypes.c_void_p]
+        lib.kv_dirty_enabled.restype = ctypes.c_int
+        lib.kv_dirty_enabled.argtypes = [ctypes.c_void_p]
+        lib.kv_dirty_count.restype = ctypes.c_long
+        lib.kv_dirty_count.argtypes = [ctypes.c_void_p]
+        lib.kv_dead_count.restype = ctypes.c_long
+        lib.kv_dead_count.argtypes = [ctypes.c_void_p]
+        lib.kv_export_dirty.restype = ctypes.c_long
+        lib.kv_export_dirty.argtypes = [
+            ctypes.c_void_p, i64p, f32p, u64p, ctypes.c_long,
+            ctypes.c_int,
+        ]
+        lib.kv_export_dead.restype = ctypes.c_long
+        lib.kv_export_dead.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_long, ctypes.c_int,
+        ]
+        lib.kv_clear_dirty.argtypes = [ctypes.c_void_p]
+        lib.kv_delete.restype = ctypes.c_long
+        lib.kv_delete.argtypes = [ctypes.c_void_p, i64p, ctypes.c_long]
         lib.kv_apply_sparse_sgd.argtypes = [
             ctypes.c_void_p, i64p, f32p, ctypes.c_long, ctypes.c_float,
         ]
@@ -309,6 +328,95 @@ class KvVariable:
             self._handle, _i64(keys), _f32(values), _u64(freq), n
         )
         return keys[:got], values[:got], freq[:got]
+
+    # -- dirty-row delta surface (serving-plane incremental export) ---------
+
+    def enable_dirty_tracking(self) -> None:
+        """Arm dirty/dead tracking (the serving publisher does this
+        at construction).  OPT-IN: untracked jobs pay nothing on the
+        optimizer hot path and accumulate no set overhead.  Mutations
+        before arming are not tracked — baseline with a full
+        snapshot (the publisher's first publish is always a base)."""
+        self._lib.kv_dirty_enable(self._handle)
+
+    def dirty_tracking_enabled(self) -> bool:
+        return bool(self._lib.kv_dirty_enabled(self._handle))
+
+    def dirty_count(self) -> int:
+        """Rows touched (value or frequency) since the last cleared
+        delta export — the next delta's size, and the bound on its
+        export stall (O(rows touched), never O(table))."""
+        return int(self._lib.kv_dirty_count(self._handle))
+
+    def dead_count(self) -> int:
+        """Deletion tombstones (evicted keys) accumulated since the
+        last cleared delta export."""
+        return int(self._lib.kv_dead_count(self._handle))
+
+    def export_dirty(
+        self, clear: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Export only the rows touched since the last cleared delta
+        (spill-tier rows read in place, no promotion).  With
+        ``clear``, exactly the exported keys leave the dirty set
+        atomically with the export — a concurrent mutation stays
+        dirty for the NEXT delta instead of silently vanishing."""
+        chunks = []
+        while True:
+            n = self.dirty_count()
+            if n == 0:
+                break
+            keys = np.empty(n, dtype=np.int64)
+            values = np.empty((n, self.dim), dtype=np.float32)
+            freq = np.empty(n, dtype=np.uint64)
+            got = self._lib.kv_export_dirty(
+                self._handle, _i64(keys), _f32(values), _u64(freq),
+                n, int(clear),
+            )
+            chunks.append((keys[:got], values[:got], freq[:got]))
+            # without clear, one pass covers the snapshot; with
+            # clear, loop until the set drains (mutations racing the
+            # export can top it back up — they belong to this delta
+            # only if we catch them, the next one otherwise)
+            if not clear or self.dirty_count() == 0:
+                break
+        if not chunks:
+            return (
+                np.empty(0, np.int64),
+                np.empty((0, self.dim), np.float32),
+                np.empty(0, np.uint64),
+            )
+        if len(chunks) == 1:
+            return chunks[0]
+        return (
+            np.concatenate([c[0] for c in chunks]),
+            np.concatenate([c[1] for c in chunks]),
+            np.concatenate([c[2] for c in chunks]),
+        )
+
+    def export_dead(self, clear: bool = False) -> np.ndarray:
+        """The delta's deletion tombstones."""
+        n = self.dead_count()
+        keys = np.empty(n, dtype=np.int64)
+        got = self._lib.kv_export_dead(
+            self._handle, _i64(keys), n, int(clear)
+        )
+        return keys[:got]
+
+    def clear_dirty(self):
+        """Reset both delta sets (a full-snapshot export baselines
+        the next delta)."""
+        self._lib.kv_clear_dirty(self._handle)
+
+    def delete(self, keys) -> int:
+        """Remove specific keys from either tier (delta tombstone
+        apply on a serving replica); returns how many existed."""
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        if keys.size == 0:
+            return 0
+        return int(
+            self._lib.kv_delete(self._handle, _i64(keys), keys.size)
+        )
 
     def import_(self, keys, values, freq=None):
         keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)

@@ -1,0 +1,54 @@
+"""Serving plane: train-to-serve embedding publication.
+
+The reference system exists to power recommendation **serving** —
+DLRover/TFPlus ship dirty-row delta checkpoints
+(``tfplus/kv_variable/python/training/checkpoint_manager.py:72``)
+precisely so a continuously-trained multi-GB embedding table can be
+republished to read replicas without full-table stalls.  This package
+closes that loop on the sparse-elasticity infrastructure the
+checkpoint PRs built:
+
+- :class:`~dlrover_tpu.serving.publisher.EmbeddingPublisher` — the
+  trainer-side half.  Publishes **generations** of a
+  :class:`~dlrover_tpu.checkpoint.sparse.SparseStateAdapter`'s tables
+  through the committed-storage tier: a *base* generation is a full
+  snapshot, a *delta* generation carries only the rows touched since
+  the previous publish (plus eviction tombstones) — the export stall
+  is O(rows touched per interval), never O(table).  Every generation
+  commits with the done-file discipline (blobs + manifest, then a
+  ``DONE`` marker, then an atomic tracker advance), so a trainer
+  killed mid-publish leaves an ignorable partial directory and its
+  replacement's next publish is exactly-once at a fresh generation.
+
+- :class:`~dlrover_tpu.serving.replica.ServingReplica` — the
+  read-only serving half.  Ingests committed generations
+  incrementally (base, then the delta chain) while serving lookup
+  traffic through the native host-gather path; per-generation content
+  digests (the order-independent additive sums from the sparse
+  checkpoint work) are re-computed over what was actually applied and
+  must match the manifest, so the event log alone proves the replica
+  never served a torn, uncommitted or partially-ingested generation.
+  Generation transitions are atomic with respect to lookups (a swap
+  lock held for the O(delta) apply), bounding lookup p99 under
+  concurrent ingest by the delta size.
+
+- ``python -m dlrover_tpu.serving`` — a standalone replica process:
+  polls the serving directory, ingests, and drives seeded lookup
+  traffic, emitting ``serving_publish`` / ``serving_ingest`` /
+  ``serving_freshness`` / ``serving_lookup_stats`` events plus the
+  ``dlrover_serving_*`` metrics the bench and chaos invariants read.
+"""
+
+from dlrover_tpu.serving.publisher import (
+    EmbeddingPublisher,
+    SERVING_TRACKER,
+    committed_generation,
+)
+from dlrover_tpu.serving.replica import ServingReplica
+
+__all__ = [
+    "EmbeddingPublisher",
+    "SERVING_TRACKER",
+    "ServingReplica",
+    "committed_generation",
+]

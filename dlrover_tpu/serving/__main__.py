@@ -1,0 +1,145 @@
+"""Standalone serving-replica process.
+
+``python -m dlrover_tpu.serving --dir <serving_dir>`` runs a
+read-only replica next to a live training job: an ingest poller keeps
+the tables at the newest committed generation while the main thread
+drives seeded lookup traffic through the native host-gather path —
+the "user traffic" half of the train-to-serve loop.  Lookup latency
+is sampled per batch and shipped as periodic ``serving_lookup_stats``
+events (count, p50/p99 ms, qps, served generation), so freshness AND
+tail latency under concurrent ingest are decidable from the event log
+alone — the same substrate every chaos invariant reads.
+
+Arms chaos from ``DLROVER_CHAOS`` like every other job process (the
+``serving.ingest`` hook lives inside the replica's apply path), and
+exits cleanly on SIGTERM, ``--duration`` expiry, or the appearance of
+``--stop-file``.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.replica import ServingReplica
+from dlrover_tpu.telemetry.events import emit_event
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dlrover_tpu.serving",
+        description="read-only embedding serving replica",
+    )
+    parser.add_argument("--dir", required=True,
+                        help="serving directory (publisher output)")
+    parser.add_argument("--poll", type=float, default=0.2,
+                        help="ingest poll interval seconds")
+    parser.add_argument("--batch", type=int, default=256,
+                        help="lookup batch size")
+    parser.add_argument("--key-space", type=int, default=4000,
+                        help="lookup keys drawn from [0, key_space)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="lookup traffic seed")
+    parser.add_argument("--qps", type=float, default=0.0,
+                        help="target lookup batches/s (0 = max rate)")
+    parser.add_argument("--duration", type=float, default=0.0,
+                        help="exit after this many seconds (0 = run "
+                             "until stopped)")
+    parser.add_argument("--stop-file", default="",
+                        help="exit when this path appears")
+    parser.add_argument("--stats-every", type=float, default=1.0,
+                        help="serving_lookup_stats cadence seconds")
+    args = parser.parse_args(argv)
+
+    stop = threading.Event()
+
+    def _on_term(signum, frame):  # noqa: ARG001
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    replica = ServingReplica(args.dir)
+
+    def poller():
+        while not stop.wait(args.poll):
+            try:
+                replica.ingest_pending()
+            except Exception:  # noqa: BLE001 - keep serving
+                logger.exception("serving ingest poll failed")
+
+    threading.Thread(target=poller, daemon=True,
+                     name="serving-ingest").start()
+
+    rng = np.random.default_rng(args.seed)
+    deadline = (
+        time.monotonic() + args.duration if args.duration else None
+    )
+    samples = []
+    window_t0 = time.monotonic()
+    lookups = rows = 0
+    min_interval = 1.0 / args.qps if args.qps > 0 else 0.0
+    while not stop.is_set():
+        now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            break
+        if args.stop_file and os.path.exists(args.stop_file):
+            break
+        if not replica.tables:
+            # nothing committed yet: wait for the first base
+            try:
+                replica.ingest_pending()
+            except Exception:  # noqa: BLE001
+                logger.exception("serving ingest failed")
+            time.sleep(min(args.poll, 0.1))
+            continue
+        keys = rng.integers(
+            0, args.key_space, args.batch
+        ).astype(np.int64)
+        t0 = time.perf_counter()
+        replica.lookup(keys)
+        samples.append(time.perf_counter() - t0)
+        lookups += 1
+        rows += args.batch
+        if min_interval:
+            time.sleep(min_interval)
+        if now - window_t0 >= args.stats_every and samples:
+            arr = np.asarray(samples)
+            window_s = now - window_t0
+            emit_event(
+                "serving_lookup_stats",
+                count=int(lookups),
+                rows=int(rows),
+                p50_ms=round(float(np.percentile(arr, 50)) * 1e3, 4),
+                p99_ms=round(float(np.percentile(arr, 99)) * 1e3, 4),
+                qps=round(lookups / window_s, 2),
+                window_s=round(window_s, 3),
+                generation=replica.generation,
+            )
+            samples = []
+            lookups = rows = 0
+            window_t0 = now
+    # final window so short runs still report
+    if samples:
+        arr = np.asarray(samples)
+        window_s = max(1e-9, time.monotonic() - window_t0)
+        emit_event(
+            "serving_lookup_stats",
+            count=int(lookups),
+            rows=int(rows),
+            p50_ms=round(float(np.percentile(arr, 50)) * 1e3, 4),
+            p99_ms=round(float(np.percentile(arr, 99)) * 1e3, 4),
+            qps=round(lookups / window_s, 2),
+            window_s=round(window_s, 3),
+            generation=replica.generation,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

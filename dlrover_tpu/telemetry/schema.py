@@ -117,7 +117,40 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
            ["step", "rank", "tier", "seconds", "tables",
             "spilled_rows", "spill_disabled", "lost_rows",
             "resharded", "from_world", "world_size", "total_rows",
-            "digests"]),
+            "digests",
+            # dirty-row delta exports (serving plane): delta=True
+            # marks an export of only the rows touched since the
+            # last cleared delta (dead_rows = eviction tombstones,
+            # table_rows = logical table size for the delta ratio)
+            "delta", "dead_rows", "table_rows"]),
+        # -- serving plane (train-to-serve publication) --------------
+        # one committed generation published by the trainer: kind =
+        # base (full snapshot) or delta (dirty rows + tombstones);
+        # emitted AFTER the tracker advance, so per-generation
+        # exactly-once publication is countable from the log; tables
+        # carries the per-table content digests the ingest must match
+        _s("serving_publish",
+           ["generation", "kind", "rows", "bytes", "seconds"],
+           ["step", "dead_rows", "delta_ratio", "tables"]),
+        # one generation applied on a replica, emitted only after the
+        # FULL apply under the swap lock — its digests (restated from
+        # the verified manifest) tie it to the matching publish: a
+        # torn or uncommitted generation can never produce this event
+        _s("serving_ingest",
+           ["generation", "kind", "rows", "seconds"],
+           ["step", "dead_rows", "bytes", "freshness_s", "respawned",
+            "tables"]),
+        # train-commit -> servable latency of the generation now
+        # being served, after each catch-up
+        _s("serving_freshness",
+           ["generation", "freshness_s"],
+           ["step", "lag_generations", "respawned"]),
+        # periodic lookup-traffic sample from the replica process:
+        # latency percentiles + throughput under (possibly) live
+        # ingest, tagged with the served generation
+        _s("serving_lookup_stats",
+           ["count", "p50_ms", "p99_ms", "qps", "window_s"],
+           ["rows", "generation"]),
         # -- agent ---------------------------------------------------
         # reason: failure / membership / hang / resize — what drove
         # this restart (resize restarts are planned drains)

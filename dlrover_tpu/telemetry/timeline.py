@@ -89,6 +89,10 @@ CAT_RESTART = "restart"
 CAT_CHECKPOINT = "checkpoint"
 CAT_SHARD = "shard_lease"
 CAT_STEP = "train_step"
+# serving plane (train-to-serve publication): publish slices on the
+# trainer side, ingest slices on the replica side — display
+# categories (serving work is not training goodput loss)
+CAT_SERVING = "serving"
 # the measured death->first-step budget from the trainer-side
 # RecoveryProfiler: per-phase sub-slices of a restart window.  A
 # DISPLAY category, deliberately outside CAUSE_PRIORITY: the same
@@ -176,8 +180,31 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
         if etype in ("chaos_inject", "loss_spike",
                      "diagnosis_verdict", "hang_evidence",
                      "rpc_slo_breach", "compile_cache", "aot_cache",
-                     "fleet_report", "fleet_capacity"):
+                     "fleet_report", "fleet_capacity",
+                     "serving_freshness", "serving_lookup_stats"):
             tl.instants.append(e)
+            continue
+        if etype in ("serving_publish", "serving_ingest"):
+            secs = _num(e.get("seconds"))
+            side = (
+                "publish" if etype == "serving_publish" else "ingest"
+            )
+            name = (
+                f"serving {side}[{e.get('kind')}] "
+                f"gen {e.get('generation')}"
+            )
+            tl.slices.append(Slice(
+                name=name,
+                cat=CAT_SERVING,
+                start=ts - secs, end=ts,
+                track=(
+                    "serving replica" if side == "ingest" else track
+                ),
+                meta={k: e.get(k) for k in (
+                    "generation", "kind", "rows", "dead_rows",
+                    "step", "freshness_s", "delta_ratio",
+                ) if e.get(k) is not None},
+            ))
             continue
         if etype == "recovery_phase":
             # emitted at phase END with the measured duration: the
@@ -779,6 +806,20 @@ def _describe_instant(e: Dict) -> str:
             f"trace={_num(e.get('trace_s')):.3f}s "
             f"wrote={bool(e.get('wrote'))}"
         )
+    if etype == "serving_freshness":
+        return (
+            f"gen {e.get('generation')} servable "
+            f"{_num(e.get('freshness_s')):.3f}s after train commit "
+            f"(lag {e.get('lag_generations', 0)} gen)"
+        )
+    if etype == "serving_lookup_stats":
+        return (
+            f"{e.get('count')} lookup batch(es) "
+            f"p50={_num(e.get('p50_ms')):.2f}ms "
+            f"p99={_num(e.get('p99_ms')):.2f}ms "
+            f"@ {_num(e.get('qps')):.0f} batch/s "
+            f"gen {e.get('generation')}"
+        )
     if etype == "fleet_report":
         return (
             f"{e.get('agents')} agents {_num(e.get('rps')):.0f} "
@@ -930,6 +971,28 @@ def to_report(
                 f"  node{rank} restart#{count}: {total:.3f}s  "
                 f"({parts}){cache_txt}{aot_txt}"
             )
+    serving = tl.slices_by_cat(CAT_SERVING)
+    if serving:
+        publishes = [
+            s for s in serving if s.name.startswith("serving publish")
+        ]
+        ingests = [
+            s for s in serving if s.name.startswith("serving ingest")
+        ]
+        fresh = [
+            _num(s.meta.get("freshness_s")) for s in ingests
+            if s.meta.get("freshness_s") is not None
+        ]
+        line = (
+            f"serving plane: {len(publishes)} publish(es), "
+            f"{len(ingests)} ingest(s)"
+        )
+        if fresh:
+            line += (
+                f", freshness max {max(fresh):.3f}s "
+                f"last {fresh[-1]:.3f}s"
+            )
+        lines.append(line)
     slo_breaches = [
         e for e in tl.instants if e.get("type") == "rpc_slo_breach"
     ]

@@ -68,6 +68,16 @@ def _fat_snapshot() -> dict:
             },
         },
         "input_pipeline": {"input_bound_pct": 12.345678},
+        "serving": {
+            "freshness_mean_s": 0.123456,
+            "freshness_max_s": 0.234567,
+            "lookup_p99_under_ingest_ms": 1.234567,
+            "lookup_p99_quiet_ms": 0.912345,
+            "delta_ratio": 0.021234,
+            "export_stall_speedup": 43.212345,
+            "full_export_s": 0.345678,
+            "delta_export_s": 0.008123,
+        },
         "gqa_attention_kernel": {"seq2048": {"speedup": 1.812345}},
         "attention_kernel": {"seq8192": {"flash_vs_xla_speedup": 2.9}},
         "elastic_recovery": {
@@ -90,7 +100,8 @@ def _fat_snapshot() -> dict:
         "goodput", "llama_train_step", "train_step", "xl_train_step",
         "xl_act_offload", "flash_ckpt", "auto_config", "sparse_kv",
         "input_pipeline", "gqa_attention_kernel", "attention_kernel",
-        "elastic_recovery", "multislice", "sequence_parallel",
+        "elastic_recovery", "serving", "multislice",
+        "sequence_parallel",
     ]
     for name in sections:
         snap[f"{name}_error"] = "boom " * 50

@@ -621,3 +621,82 @@ def test_master_respawn_other_host(tmp_path):
     # every flush's lag stayed within a few group-commit windows
     # (scheduling jitter rides on top of the 0.05s interval)
     assert all(e.get("lag_s", 0) < 5.0 for e in flushes), flushes
+
+
+def test_serving_replica_kill_midingest(tmp_path):
+    """ISSUE 13 acceptance (tier-1): the serving replica is SIGKILLed
+    INSIDE a generation apply (swap lock held, tables half-applied).
+    The respawned replica re-bases from the newest committed
+    generation and converges on the trainer's final publish; the
+    digest chain on serving_ingest vs serving_publish events proves
+    the replica never served a torn or uncommitted generation — all
+    decided from the event log alone."""
+    report = harness.run_serving_scenario(
+        scenarios.serving_replica_kill_midingest(seed=83),
+        workdir=str(tmp_path / "run"),
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    # exactly one seeded kill, inside the replica's ingest hook
+    assert len(report.timeline) == 1, report.timeline
+    _seq, point, _rule, action, _step = report.timeline[0]
+    assert point == "serving.ingest" and action == "kill"
+    # the generation being applied at the kill emitted NO ingest
+    # event from the first replica life (the event is post-apply):
+    # every recorded ingest digest-matches its publish, and the
+    # respawned replica's trail starts with a base
+    ingests = [
+        e for e in report.events
+        if e.get("type") == "serving_ingest"
+    ]
+    respawned = [e for e in ingests if e.get("respawned")]
+    assert respawned and respawned[0]["kind"] == "base"
+    # lookup traffic ran, and freshness was measured
+    lookups = [
+        e for e in report.events
+        if e.get("type") == "serving_lookup_stats"
+    ]
+    assert lookups and all(e["p99_ms"] > 0 for e in lookups)
+    fresh = [
+        e for e in report.events
+        if e.get("type") == "serving_freshness"
+    ]
+    assert fresh, "no serving_freshness events"
+
+
+def test_serving_trainer_kill_midpublish(tmp_path):
+    """ISSUE 13 acceptance (tier-1): the trainer is SIGKILLed between
+    a generation's blobs/manifest and its DONE marker.  The
+    half-published generation never commits (the replica keeps
+    serving the previous one), the respawned trainer restores from
+    the flash checkpoint and re-bases at a fresh number, and every
+    committed generation carries exactly one serving_publish event —
+    publish exactly-once across the replacement, with the restored
+    loss trajectory still equal to the uninterrupted control."""
+    report = harness.run_serving_scenario(
+        scenarios.serving_trainer_kill_midpublish(seed=89),
+        workdir=str(tmp_path / "run"),
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    assert len(report.timeline) == 1, report.timeline
+    _seq, point, _rule, action, _step = report.timeline[0]
+    assert point == "serving.publish" and action == "kill"
+    # the replacement's first publish after the fault is a BASE (a
+    # fresh publisher cannot know what its predecessor half-wrote)
+    fault_ts = min(
+        e["ts"] for e in report.events
+        if e.get("type") == "chaos_inject"
+    )
+    post = [
+        e for e in report.events
+        if e.get("type") == "serving_publish" and e["ts"] >= fault_ts
+    ]
+    assert post and post[0]["kind"] == "base", post[:2]
+    # serving slices landed on the assembled timeline (the flight
+    # recorder's "serving" track)
+    from dlrover_tpu.telemetry.timeline import CAT_SERVING
+
+    assert report.job_timeline is not None
+    serving_slices = report.job_timeline.slices_by_cat(CAT_SERVING)
+    assert serving_slices, "no serving slices on the timeline"

@@ -775,6 +775,115 @@ def test_resync_reconciles_multiple_acks_in_one_window(tmp_path):
     assert ds.completed_count == 2
 
 
+def test_append_many_one_lock_one_fsync_replay_equal(
+    tmp_path, monkeypatch,
+):
+    """The multi-record append (ISSUE 13 satellite): a 64-record
+    batch claims the io lock once and fsyncs ONCE — the per-record
+    flavour paid 64 — while replay sees exactly the same contiguous,
+    CRC-clean record stream a sequential append loop would have
+    produced."""
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        jmod.os, "fsync",
+        lambda fd: (calls.append(fd), real_fsync(fd))[1],
+    )
+    j = StateJournal(str(tmp_path / "batch"))
+    base = len(calls)
+    records = [("ack_reconciled", {"dataset": "ds", "task_id": i})
+               for i in range(64)]
+    seqs = j.append_many(records)
+    assert len(calls) == base + 1  # one fsync for the whole batch
+    assert seqs == list(range(seqs[0], seqs[0] + 64))
+    assert j.append_many([]) == []  # no-op, no io
+    j.close()
+
+    # the sequential twin replays identically (minus seq offsets)
+    j2 = StateJournal(str(tmp_path / "seq"))
+    for kind, data in records:
+        j2.append(kind, data)
+    j2.close()
+    r1 = jmod.replay_dir(str(tmp_path / "batch"))
+    r2 = jmod.replay_dir(str(tmp_path / "seq"))
+    assert [(k, d) for _s, k, d in r1.entries] == [
+        (k, d) for _s, k, d in r2.entries
+    ]
+    assert r1.last_seq == r2.last_seq
+
+
+def test_append_many_respects_window_and_durable_kinds(
+    tmp_path, monkeypatch,
+):
+    """Under a group-commit window a routine batch rides the flusher
+    (zero inline fsyncs); a batch containing a DURABLE kind fsyncs
+    inline — same contract as single appends."""
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        jmod.os, "fsync",
+        lambda fd: (calls.append(fd), real_fsync(fd))[1],
+    )
+    j = StateJournal(str(tmp_path), fsync_window_s=30.0)
+    base = len(calls)
+    j.append_many([("node", {"i": i}) for i in range(10)])
+    assert len(calls) == base  # batched into the window
+    assert j._fsync_pending
+    j.append_many([
+        ("node", {"i": 99}), ("decision", {"kind": "no_relaunch"}),
+    ])
+    assert len(calls) == base + 1  # durable kind drains the batch
+    assert not j._fsync_pending
+    j.close()
+
+
+def test_batched_reconcile_journals_in_one_claim(
+    tmp_path, monkeypatch,
+):
+    """TaskManager.reconcile_acked_tasks closes every lease of the
+    resync history with ONE journal batch (one fsync), and the
+    journaled records replay to the same sharding state as the
+    per-ack flavour."""
+    from dlrover_tpu.common.messages import DatasetShardParams
+    from dlrover_tpu.master.task_manager import TaskManager
+
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        jmod.os, "fsync",
+        lambda fd: (calls.append(fd), real_fsync(fd))[1],
+    )
+    tm = TaskManager()
+    tm.journal = StateJournal(str(tmp_path))
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="ds", batch_size=1, dataset_size=16,
+        num_minibatches_per_shard=1, storage_type="table",
+    ))
+    leases = [tm.get_dataset_task(0, "ds") for _ in range(16)]
+    base = len(calls)
+    pairs = [("ds", t.task_id) for t in leases]
+    # garbage entries are ignored without burning the batch
+    pairs += [("", 1), ("ds", -1), ("nope", 2), ("ds", 999)]
+    assert tm.reconcile_acked_tasks(pairs) == 16
+    assert len(calls) == base + 1  # one fsync for 16 reconciles
+    ds = tm._datasets["ds"]
+    assert ds.completed_count == 16 and not ds.doing
+    # an empty / all-garbage batch journals nothing
+    assert tm.reconcile_acked_tasks([("ds", 999)]) == 0
+    assert len(calls) == base + 1
+    tm.journal.close()
+    replay = jmod.replay_dir(str(tmp_path))
+    recon = [e for e in replay.entries if e[1] == "ack_reconciled"]
+    assert len(recon) == 16
+    # replay onto a fresh manager reproduces the closed leases
+    tm2 = TaskManager()
+    tm2.restore_state({})
+    for _seq, kind, data in replay.entries:
+        tm2.apply_journal_entry(kind, data)
+    ds2 = tm2._datasets["ds"]
+    assert ds2.completed_count == 16 and not ds2.doing
+
+
 # -- local append group-commit (fsync window) -------------------------------
 
 

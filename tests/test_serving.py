@@ -1,0 +1,455 @@
+"""Serving plane: dirty-row delta exports, the publisher/replica
+commit protocol, and the delta-compaction edge cases (ISSUE 13).
+
+Everything here is CPU-only and sub-second: the native KvVariable
+delta surface (dirty/dead tracking through spill passes and
+evictions), SparseStateAdapter.export_delta/apply_delta chain
+equivalence against a full-snapshot twin, digest additivity across
+base+delta chains, and the EmbeddingPublisher / ServingReplica
+generation protocol (torn-read refusal, exactly-once across a
+simulated mid-publish death, atomic generation swaps under
+concurrent lookups)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.sparse import (
+    SparseStateAdapter,
+    keys_digest,
+    rows_digest,
+)
+from dlrover_tpu.ops.kv_variable import (
+    GroupAdamOptimizer,
+    KvVariable,
+)
+from dlrover_tpu.serving import (
+    EmbeddingPublisher,
+    ServingReplica,
+    committed_generation,
+)
+from dlrover_tpu.serving.publisher import (
+    DONE_MARKER,
+    gen_dirname,
+)
+from dlrover_tpu.serving.replica import TornGenerationError
+
+DIM = 8
+
+
+def _digest(table) -> int:
+    return rows_digest(*table.export())
+
+
+def _train_interval(table, opt, seed, n=32, key_space=500):
+    """One publish interval of mutation: gather + optimizer step."""
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, key_space, n).astype(np.int64)
+    table.gather(keys)
+    opt.apply_gradients(
+        keys, rng.normal(size=(n, table.dim)).astype(np.float32)
+    )
+    return keys
+
+
+# -- native delta surface ---------------------------------------------------
+
+
+def test_dirty_tracking_marks_only_touched_keys():
+    t = KvVariable(DIM, name="t")
+    t.enable_dirty_tracking()
+    t.insert(np.arange(50, dtype=np.int64),
+             np.zeros((50, DIM), np.float32))
+    t.clear_dirty()
+    t.scatter_add(np.array([3, 7]), np.ones((2, DIM), np.float32))
+    assert t.dirty_count() == 2
+    keys, values, freq = t.export_dirty()
+    assert sorted(keys) == [3, 7]
+    # read-only gather (serving path) never dirties
+    t.clear_dirty()
+    t.gather_or_zeros(np.arange(50, dtype=np.int64))
+    assert t.dirty_count() == 0
+    # counting gather dirties (frequency is checkpoint state)
+    t.gather(np.array([1], dtype=np.int64))
+    assert t.dirty_count() == 1
+
+
+def test_dirty_set_survives_spill_pass(tmp_path):
+    """Residence moves (DRAM -> cold tier) are not mutations: a spill
+    pass leaves the dirty set intact, and export_dirty reads the
+    spilled rows in place, bit-identical to the full export."""
+    t = KvVariable(DIM, name="sp")
+    t.enable_dirty_tracking()
+    rng = np.random.default_rng(0)
+    t.insert(np.arange(200, dtype=np.int64),
+             rng.normal(size=(200, DIM)).astype(np.float32))
+    assert t.dirty_count() == 200
+    t.enable_spill(str(tmp_path / "sp.spill"), 40)
+    assert t.spill_stats()["disk_rows"] > 0
+    assert t.dirty_count() == 200
+    dk, dv, df = t.export_dirty()
+    assert rows_digest(dk, dv, df) == _digest(t)
+    # promotion back is not a mutation either
+    t.clear_dirty()
+    t.gather_or_zeros(np.arange(200, dtype=np.int64))
+    assert t.dirty_count() == 0
+
+
+def test_delete_and_tombstones(tmp_path):
+    """kv_delete removes from either tier with probe chains intact;
+    evictions tombstone into the dead set; re-touch resurrects."""
+    t = KvVariable(DIM, name="d")
+    t.enable_dirty_tracking()
+    t.insert(np.arange(100, dtype=np.int64),
+             np.ones((100, DIM), np.float32))
+    t.enable_spill(str(tmp_path / "d.spill"), 30)
+    t.clear_dirty()
+    # delete a DRAM-resident and a spilled key
+    assert t.delete(np.array([0, 99], dtype=np.int64)) == 2
+    assert len(t) == 98
+    assert sorted(t.export_dead()) == [0, 99]
+    # every remaining key still findable (backward-shift correctness)
+    got = t.gather_or_zeros(np.arange(100, dtype=np.int64))
+    missing = np.where(~got.any(axis=1))[0]
+    assert sorted(missing) == [0, 99]
+    # re-touch one dead key: it leaves the tombstone set
+    t.gather(np.array([0], dtype=np.int64))
+    assert sorted(t.export_dead()) == [99]
+    assert 0 in t.export_dirty()[0]
+
+
+def test_delta_over_evicted_row():
+    """A row touched then evicted inside one interval exports as a
+    tombstone only; a twin applying the delta drops the row."""
+    src = KvVariable(DIM, name="e")
+    src.enable_dirty_tracking()
+    twin = KvVariable(DIM, name="e")
+    twin.enable_dirty_tracking()
+    src.insert(np.arange(20, dtype=np.int64),
+               np.ones((20, DIM), np.float32))
+    twin.import_(*src.export())
+    src.clear_dirty()
+    twin.clear_dirty()
+    # bump key 5 (dirty), then evict everything with freq < 1
+    # (key 5 survives, the untouched rest dies)
+    src.gather(np.array([5], dtype=np.int64))
+    evicted = src.evict_below(1)
+    assert evicted == 19
+    keys, values, freq = src.export_dirty()
+    dead = src.export_dead()
+    assert list(keys) == [5]
+    assert len(dead) == 19 and 5 not in dead
+    # twin applies: delete-then-import
+    twin.delete(dead)
+    twin.import_(keys, values, freq)
+    assert _digest(twin) == _digest(src)
+    assert len(twin) == 1
+
+
+def test_delta_chain_replay_bit_identical_to_full_snapshot_twin(
+    tmp_path,
+):
+    """The compaction-edge acceptance: replay a base + delta chain —
+    with evictions mid-chain — onto a SPILL-ENABLED twin; the result
+    is bit-identical (content digest) to a full-snapshot import of
+    the source at every link."""
+    os.environ.pop("DLROVER_KV_DIGEST", None)
+    src_t = KvVariable(DIM, name="c")
+    src_opt = GroupAdamOptimizer(src_t)
+    src = SparseStateAdapter(digest=True).register_table(src_t)
+    src.enable_dirty_tracking()
+
+    twin_t = KvVariable(DIM, name="c")
+    twin_t.enable_spill(str(tmp_path / "twin.spill"), 50)
+    twin = SparseStateAdapter(digest=True).register_table(twin_t)
+
+    # base
+    _train_interval(src_t, src_opt, seed=1)
+    base = src.export_state()
+    src_t.clear_dirty()
+    twin.import_state(base)
+    assert _digest(twin_t) == _digest(src_t)
+
+    for i in range(2, 7):
+        _train_interval(src_t, src_opt, seed=i)
+        if i == 4:
+            # mid-chain eviction: tombstones must flow through
+            src_t.evict_below(2)
+        delta = src.export_delta(clear=True)
+        twin.apply_delta(delta)
+        assert _digest(twin_t) == _digest(src_t), (
+            f"chain diverged at link {i}"
+        )
+    # the twin's spill tier was genuinely active during the replay
+    assert twin_t.spill_stats()["spills"] > 0
+
+
+def test_digest_additivity_across_base_plus_delta_chain():
+    """rows_digest is additive over disjoint row sets: the full
+    table's digest equals (digest of never-touched base rows +
+    digest of the final version of every touched row) mod 2**64 —
+    what lets an auditor prove a served table == base + chain without
+    materializing intermediate states."""
+    t = KvVariable(DIM, name="a")
+    t.enable_dirty_tracking()
+    rng = np.random.default_rng(7)
+    t.insert(np.arange(300, dtype=np.int64),
+             rng.normal(size=(300, DIM)).astype(np.float32))
+    t.clear_dirty()
+    touched = np.unique(
+        rng.integers(0, 300, 120)
+    ).astype(np.int64)
+    t.scatter_add(
+        touched,
+        rng.normal(size=(len(touched), DIM)).astype(np.float32),
+    )
+    dk, dv, df = t.export_dirty()
+    assert set(dk) == set(touched)
+    fk, fv, ff = t.export()
+    untouched = ~np.isin(fk, touched)
+    part_sum = (
+        rows_digest(fk[untouched], fv[untouched], ff[untouched])
+        + rows_digest(dk, dv, df)
+    ) % (1 << 64)
+    assert part_sum == rows_digest(fk, fv, ff)
+    # and the tombstone digest is the same additive shape over keys
+    assert keys_digest(np.array([1, 2], np.int64)) == (
+        keys_digest(np.array([1], np.int64))
+        + keys_digest(np.array([2], np.int64))
+    ) % (1 << 64)
+
+
+def test_dirty_tracking_is_opt_in():
+    """Jobs that never publish deltas pay nothing: tracking is OFF
+    by default — mutations accumulate no dirty/dead state — and the
+    publisher arms it at construction."""
+    t = KvVariable(DIM, name="off")
+    assert not t.dirty_tracking_enabled()
+    t.insert(np.arange(50, dtype=np.int64),
+             np.ones((50, DIM), np.float32))
+    t.gather(np.arange(50, dtype=np.int64))
+    t.evict_below(1)
+    assert t.dirty_count() == 0 and t.dead_count() == 0
+    t.enable_dirty_tracking()
+    t.scatter_add(np.array([1]), np.ones((1, DIM), np.float32))
+    assert t.dirty_count() == 1
+
+
+# -- publisher / replica protocol -------------------------------------------
+
+
+def _mk_publisher(tmp_path, compact_every=4):
+    t = KvVariable(DIM, name="emb")
+    opt = GroupAdamOptimizer(t)
+    adapter = SparseStateAdapter(digest=True).register_table(t)
+    pub = EmbeddingPublisher(
+        adapter, str(tmp_path / "serving"),
+        compact_every=compact_every,
+    )
+    return t, opt, pub
+
+
+def test_publish_ingest_round_trip(tmp_path):
+    t, opt, pub = _mk_publisher(tmp_path)
+    for step in range(1, 7):
+        _train_interval(t, opt, seed=step)
+        pub.publish(step=step)
+    rep = ServingReplica(str(tmp_path / "serving"))
+    applied = rep.ingest_pending()
+    assert applied and rep.generation == pub.generation
+    assert _digest(rep.tables["emb"]) == _digest(t)
+    out = rep.lookup(np.arange(5, dtype=np.int64))
+    assert out.shape == (5, DIM)
+    # idle poll is a no-op
+    assert rep.ingest_pending() == []
+
+
+def test_uncommitted_generation_never_served(tmp_path, monkeypatch):
+    """Kill the publisher between manifest and DONE (monkeypatched):
+    the replica must keep serving the previous generation, and the
+    replacement publisher re-bases at a fresh number — publish
+    exactly-once across the death."""
+    t, opt, pub = _mk_publisher(tmp_path)
+    _train_interval(t, opt, seed=1)
+    pub.publish(step=1)
+    rep = ServingReplica(str(tmp_path / "serving"))
+    rep.ingest_pending()
+    assert rep.generation == 1
+
+    # die mid-publish: the DONE write raises (trainer SIGKILL parity)
+    real_write = pub.storage.write
+
+    def dying_write(content, path):
+        if path.endswith(DONE_MARKER):
+            raise RuntimeError("killed mid-publish")
+        return real_write(content, path)
+
+    monkeypatch.setattr(pub.storage, "write", dying_write)
+    _train_interval(t, opt, seed=2)
+    with pytest.raises(RuntimeError):
+        pub.publish(step=2)
+    monkeypatch.undo()
+    # gen 2's dir exists but is uncommitted: tracker still says 1
+    assert committed_generation(str(tmp_path / "serving")) == 1
+    assert rep.ingest_pending() == []
+    assert rep.generation == 1
+
+    # replacement publisher (fresh process): re-bases at gen 2,
+    # discarding the partial dir
+    t2 = KvVariable(DIM, name="emb")
+    t2.import_(*t.export())
+    adapter2 = SparseStateAdapter(digest=True).register_table(t2)
+    pub2 = EmbeddingPublisher(adapter2, str(tmp_path / "serving"))
+    gen = pub2.publish(step=2)
+    assert gen == 2
+    rep.ingest_pending()
+    assert rep.generation == 2
+    assert _digest(rep.tables["emb"]) == _digest(t2)
+
+
+def test_torn_blobs_refused(tmp_path):
+    """A generation whose blobs do not match the manifest digests is
+    never applied: digest verification aborts the ingest with the
+    tables untouched."""
+    t, opt, pub = _mk_publisher(tmp_path)
+    _train_interval(t, opt, seed=1)
+    pub.publish(step=1)
+    rep = ServingReplica(str(tmp_path / "serving"))
+    rep.ingest_pending()
+    before = _digest(rep.tables["emb"])
+
+    _train_interval(t, opt, seed=2)
+    pub.publish(step=2)
+    # corrupt gen 2's blobs AFTER commit (bit rot / torn replication)
+    blob_path = os.path.join(
+        str(tmp_path / "serving"), gen_dirname(2), "blobs.npz"
+    )
+    with open(blob_path, "r+b") as f:
+        f.seek(200)
+        f.write(b"\xde\xad\xbe\xef" * 8)
+    with pytest.raises(TornGenerationError):
+        rep._load_generation(2)
+    assert rep.ingest_pending() == []
+    assert rep.generation == 1
+    assert _digest(rep.tables["emb"]) == before
+
+
+def test_rebase_after_history_pruned(tmp_path):
+    """A replica that fell behind the newest base (compaction pruned
+    the deltas it missed) heals by re-basing."""
+    t, opt, pub = _mk_publisher(tmp_path, compact_every=3)
+    gens = []
+    for step in range(1, 8):
+        _train_interval(t, opt, seed=step)
+        gens.append(pub.publish(step=step))
+    # compaction produced at least two bases and pruned pre-base
+    # history
+    rep = ServingReplica(str(tmp_path / "serving"))
+    rep.ingest_pending()
+    assert rep.generation == gens[-1]
+    assert _digest(rep.tables["emb"]) == _digest(t)
+
+
+def test_atomic_generation_swap_under_lookups(tmp_path):
+    """Torn-read proof at the lookup level: every publish writes ALL
+    rows = the generation number; concurrent lookup batches must
+    observe a UNIFORM generation — never a mix of two — because the
+    swap lock serializes delta application against lookups."""
+    serving = str(tmp_path / "serving")
+    t = KvVariable(DIM, name="g")
+    keys = np.arange(64, dtype=np.int64)
+    adapter = SparseStateAdapter(digest=True).register_table(t)
+    pub = EmbeddingPublisher(adapter, serving, compact_every=100)
+    t.insert(keys, np.full((64, DIM), 1.0, np.float32))
+    pub.publish(step=1)
+    rep = ServingReplica(serving)
+    rep.ingest_pending()
+
+    stop = threading.Event()
+    torn: list = []
+
+    def reader():
+        while not stop.is_set():
+            out = rep.lookup(keys)
+            col = out[:, 0]
+            if not np.all(col == col[0]):
+                torn.append(np.unique(col))
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for r in readers:
+        r.start()
+    try:
+        for g in range(2, 12):
+            t.insert(keys, np.full((64, DIM), float(g), np.float32))
+            pub.publish(step=g)
+            rep.ingest_pending()
+    finally:
+        stop.set()
+        for r in readers:
+            r.join()
+    assert not torn, f"torn lookup batches observed: {torn[:3]}"
+    assert float(rep.lookup(keys)[0, 0]) == 11.0
+
+
+def test_publish_events_schema_valid(tmp_path):
+    """Every serving event the publisher/replica emit validates
+    against the registered schema (the chaos invariants' substrate
+    must never fork silently)."""
+    from dlrover_tpu.telemetry import events as ev_mod
+    from dlrover_tpu.telemetry.schema import validate_event
+
+    log = str(tmp_path / "events.jsonl")
+    os.environ[ev_mod.EVENT_LOG_ENV] = log
+    try:
+        t, opt, pub = _mk_publisher(tmp_path)
+        for step in (1, 2, 3):
+            _train_interval(t, opt, seed=step)
+            pub.publish(step=step)
+        rep = ServingReplica(str(tmp_path / "serving"))
+        rep.ingest_pending()
+        recorded = ev_mod.read_events(log)
+    finally:
+        os.environ.pop(ev_mod.EVENT_LOG_ENV, None)
+    serving = [
+        e for e in recorded
+        if str(e.get("type", "")).startswith("serving_")
+        or e.get("type") == "kv_checkpoint"
+    ]
+    assert any(
+        e.get("type") == "serving_publish" for e in serving
+    )
+    assert any(
+        e.get("type") == "serving_ingest" for e in serving
+    )
+    problems = [p for e in serving for p in validate_event(e)]
+    assert not problems, problems
+
+
+def test_late_registered_table_forces_base(tmp_path):
+    """A table registered on the adapter AFTER the publisher was
+    built has no tracked history — the next publish must re-base so
+    its rows reach replicas at all (a delta would list it with zero
+    rows while replicas serve zeros)."""
+    t, opt, pub = _mk_publisher(tmp_path)
+    _train_interval(t, opt, seed=1)
+    pub.publish(step=1)
+    _train_interval(t, opt, seed=2)
+    pub.publish(step=2)  # delta — chain established
+
+    late = KvVariable(DIM, name="late")
+    late.insert(np.arange(30, dtype=np.int64),
+                np.ones((30, DIM), np.float32))
+    pub.adapter.register_table(late)
+    pub.publish(step=3)
+    rep = ServingReplica(str(tmp_path / "serving"))
+    rep.ingest_pending()
+    assert "late" in rep.tables
+    assert _digest(rep.tables["late"]) == _digest(late)
+    # and the new table is tracked from here on: a delta carries its
+    # subsequent mutations
+    late.scatter_add(np.array([3]), np.ones((1, DIM), np.float32))
+    pub.publish(step=4)
+    rep.ingest_pending()
+    assert _digest(rep.tables["late"]) == _digest(late)
