@@ -42,6 +42,7 @@ from dlrover_tpu.chaos.scenarios import (
     RESIZE_TRAIN_SCRIPT,
     RUN_OPTIONS,
     SHARD_DATASET_ENV,
+    SPARSE_RESHARD_TRAIN_SCRIPT,
     SPARSE_RESIZE_TRAIN_SCRIPT,
     SPARSE_SERVING_TRAIN_SCRIPT,
     SPARSE_TRAIN_SCRIPT,
@@ -71,7 +72,136 @@ TRAIN_SCRIPTS = {
     "resize": RESIZE_TRAIN_SCRIPT,
     "sparse_resize": SPARSE_RESIZE_TRAIN_SCRIPT,
     "sparse_serving": SPARSE_SERVING_TRAIN_SCRIPT,
+    "sparse_reshard": SPARSE_RESHARD_TRAIN_SCRIPT,
 }
+
+
+def seed_sparse_world_checkpoint(
+    ckpt_dir: str,
+    world: int = 2,
+    step: int = 4,
+    out_json: str = "",
+    n_keys: int = 1200,
+    dim: int = 16,
+) -> Dict:
+    """Write a COMMITTED ``world``-rank sparse checkpoint directly in
+    the storage layout (rank_N.ckpt/rank_N.meta + tracker) — no shm,
+    no saver — so a world-1 job restoring from ``ckpt_dir`` must run
+    the cross-world STREAMING reshard on its first load.  Each rank's
+    table holds exactly the keys ``owner_of_keys`` assigns it (a
+    distinct slice of the logical table), trained a few GroupAdam
+    steps so values/freq/slots are non-trivial.  Returns (and writes
+    to ``out_json``) the per-table additive digest sums and the
+    distinct-union row count the exactly-once invariant checks
+    against."""
+    import pickle
+
+    import numpy as np
+
+    from dlrover_tpu.checkpoint.saver import (
+        meta_file,
+        shard_file,
+        step_dirname,
+    )
+    from dlrover_tpu.checkpoint.shm_handler import (
+        CheckpointConfig,
+        TensorMeta,
+        _flatten_state_dict,
+    )
+    from dlrover_tpu.checkpoint.sparse import (
+        KV_STATE_KEY,
+        SparseStateAdapter,
+        owner_of_keys,
+        rows_digest,
+    )
+    from dlrover_tpu.common.constants import CheckpointConstant
+    from dlrover_tpu.ops.kv_variable import (
+        GroupAdamOptimizer,
+        KvVariable,
+    )
+
+    def _serialize(state_dict, rank: int) -> Tuple[Dict, bytes]:
+        """state dict -> (meta, raw) in the exact shm/storage layout
+        the engine's restore reads back."""
+        flat = _flatten_state_dict(state_dict)
+        entries, scalars = [], {}
+        for key, leaf in flat.items():
+            if isinstance(leaf, (np.ndarray, np.generic)):
+                entries.append((key, np.ascontiguousarray(leaf)))
+            else:
+                scalars[key] = leaf
+        blob = pickle.dumps(scalars)
+        metas, offset = {}, 0
+        for key, arr in entries:
+            metas[key] = TensorMeta(
+                shape=tuple(arr.shape), dtype=str(arr.dtype),
+                offset=offset, nbytes=arr.nbytes,
+            )
+            offset += arr.nbytes
+        raw = bytearray(offset + len(blob))
+        for key, arr in entries:
+            m = metas[key]
+            raw[m.offset:m.offset + m.nbytes] = arr.tobytes()
+        raw[offset:] = blob
+        meta = {
+            "tensors": metas,
+            "config": CheckpointConfig(
+                step=step, path=ckpt_dir, rank=rank,
+                world_size=world, global_shard_num=world,
+            ),
+            "scalar_offset": offset,
+            "scalar_nbytes": len(blob),
+        }
+        return meta, bytes(raw)
+
+    step_dir = os.path.join(ckpt_dir, step_dirname(step))
+    os.makedirs(step_dir, exist_ok=True)
+    keys = np.arange(n_keys, dtype=np.int64)
+    table_sums: Dict[str, int] = {}
+    union_rows = 0
+    for rank in range(world):
+        table = KvVariable(dim=dim, seed=rank + 21, name="emb")
+        opt = GroupAdamOptimizer(table, learning_rate=5e-3)
+        adapter = SparseStateAdapter(digest=True)
+        adapter.register_optimizer(opt)
+        mine = keys[owner_of_keys(keys, world) == rank]
+        rng = np.random.default_rng(rank + 3)
+        for _ in range(3):
+            batch = rng.choice(mine, size=min(256, mine.size),
+                               replace=False)
+            opt.apply_gradients(
+                batch, np.tanh(table.gather(batch)) * 0.1
+            )
+        kv_state = adapter.export_state(step=step, rank=rank)
+        for name, tbl in adapter.tables.items():
+            k, v, f = tbl.export()
+            table_sums[name] = (
+                table_sums.get(name, 0) + rows_digest(k, v, f)
+            ) % (1 << 64)
+            union_rows += len(k)
+        sd = {
+            "w": np.zeros(8, np.float32),
+            KV_STATE_KEY: kv_state,
+        }
+        meta, raw = _serialize(sd, rank)
+        with open(os.path.join(step_dir, shard_file(rank)), "wb") as f:
+            f.write(raw)
+        with open(os.path.join(step_dir, meta_file(rank)), "wb") as f:
+            f.write(pickle.dumps(meta))
+    with open(
+        os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE), "w"
+    ) as f:
+        f.write(str(step))
+    seed = {
+        "step": int(step),
+        "world": int(world),
+        "rows": int(union_rows),
+        "tables": {n: f"{s:016x}" for n, s in table_sums.items()},
+    }
+    if out_json:
+        with open(out_json, "w") as f:
+            json.dump(seed, f, indent=2)
+    return seed
 
 
 @dataclass
@@ -892,6 +1022,95 @@ class KvReshardExactlyOnce(Invariant):
             self.name, True,
             f"{len(detail)} exactly-once reshard(s): "
             + "; ".join(detail),
+        )
+
+
+class KvStreamingReshardReplayed(Invariant):
+    """A worker SIGKILLed mid-streaming-reshard is replaced by one
+    that replays the reshard from the SAME committed storage with
+    exactly-once rows, decided from events + the seeder's JSON:
+
+    - the fault fired on a ``kv.reshard_chunk`` hook (the kill landed
+      mid-stream, after at least one chunk imported);
+    - a post-fault ``kv_checkpoint`` restore with ``streamed`` ran in
+      MORE than one chunk and imported rows == total_rows == the
+      seeder's distinct union (no row lost, no chunk double-imported
+      — the in-band additive digest assert would have raised, and
+      the counts re-check it here);
+    - its per-table digests equal the seeder's per-shard export sums
+      (additive across the disjoint world-2 shards)."""
+
+    name = "kv_streaming_reshard_replayed"
+
+    def __init__(self, seed_json_path: str):
+        self.seed_json_path = seed_json_path
+
+    def check(self, events, run):
+        try:
+            with open(self.seed_json_path) as f:
+                seed = json.load(f)
+        except (OSError, ValueError) as e:
+            return InvariantResult(
+                self.name, False, f"seed JSON unreadable: {e}"
+            )
+        inj = [
+            e for e in _injections(events)
+            if e.get("point") == "kv.reshard_chunk"
+        ]
+        if not inj:
+            return InvariantResult(
+                self.name, False,
+                "no chaos_inject on kv.reshard_chunk — the kill "
+                "never landed mid-reshard",
+            )
+        fault_ts = inj[0]["ts"]
+        restores = [
+            e for e in _kv_events(events, "restore")
+            if e.get("resharded") and e.get("streamed")
+            and e["ts"] >= fault_ts
+        ]
+        if not restores:
+            return InvariantResult(
+                self.name, False,
+                "no streamed resharded kv restore after the fault",
+            )
+        r = restores[-1]
+        if int(r.get("chunks", 0)) <= 1:
+            return InvariantResult(
+                self.name, False,
+                f"reshard ran in {r.get('chunks')} chunk(s) — not "
+                "actually streamed (window too large?)",
+            )
+        rows, total = int(r.get("rows", -1)), int(
+            r.get("total_rows", -2)
+        )
+        if not (rows == total == int(seed["rows"])):
+            return InvariantResult(
+                self.name, False,
+                f"imported {rows} row(s) vs union {total} vs seeded "
+                f"{seed['rows']} — rows lost or double-imported",
+            )
+        digests = r.get("digests") or {}
+        bad = []
+        for table, want in seed.get("tables", {}).items():
+            got = (digests.get(table) or {}).get("sum")
+            if got != want:
+                bad.append(f"{table}: {got} != seeded {want}")
+        if not seed.get("tables"):
+            return InvariantResult(
+                self.name, False, "seed JSON names no tables"
+            )
+        if bad:
+            return InvariantResult(
+                self.name, False,
+                "digest mismatch vs seeded shards: " + "; ".join(bad),
+            )
+        return InvariantResult(
+            self.name, True,
+            f"replayed reshard imported {rows}/{total} row(s) in "
+            f"{r.get('chunks')} chunk(s), {len(digests)} table "
+            f"digest(s) equal the seeded sums (kill at chunk "
+            f"{inj[0].get('step')} of incarnation 0)",
         )
 
 
@@ -1955,6 +2174,19 @@ def invariants_for_scenario(
             ),
             NoOrphanProcesses(marker=workdir),
         ]
+    if name == "sparse-streaming-reshard-kill":
+        # the streaming-reshard trail: the worker died mid-reshard
+        # (no train_step in incarnation 0, so no BoundedStepLoss),
+        # the replacement replayed the reshard exactly-once against
+        # the seeder's digests, and the job still finished + committed
+        return [
+            WorkerRestarted(),
+            KvStreamingReshardReplayed(
+                os.path.join(workdir, "seed_kv.json")
+            ),
+            TrainingCompleted(total_steps=total_steps),
+            NoOrphanProcesses(marker=workdir),
+        ]
     if name == "sparse-kill-restore":
         # the sparse acceptance trail: full recovery set + the loss
         # trajectory equal to the uninterrupted DeepFM control + the
@@ -2068,6 +2300,16 @@ def run_scenario(
         f.write(TRAIN_SCRIPTS[opts.get("train_script", "default")])
     event_log = os.path.join(workdir, "events.jsonl")
     ckpt_dir = os.path.join(workdir, "ckpt")
+    if opts.get("seed_kv_world"):
+        # pre-seed a committed old-world sparse checkpoint so the
+        # job's FIRST restore is a cross-world streaming reshard;
+        # the seeder's digest sums land in seed_kv.json for the
+        # exactly-once invariant
+        seed_sparse_world_checkpoint(
+            ckpt_dir,
+            world=int(opts["seed_kv_world"]),
+            out_json=os.path.join(workdir, "seed_kv.json"),
+        )
 
     env = {
         _chaos.CHAOS_ENV: spec_path,

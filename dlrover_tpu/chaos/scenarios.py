@@ -48,6 +48,7 @@ from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
 from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
 from dlrover_tpu.trainer.elastic_trainer import (
     ElasticTrainer, TrainState, abstract_like, make_train_step,
+    restore_train_state,
 )
 from dlrover_tpu.trainer.recovery import RecoveryProfiler
 
@@ -128,9 +129,13 @@ with prof.phase("state_build"):
     if start_step is None:
         params = model.init_params(jax.random.PRNGKey(0))
         start_step = 0
+        state = TrainState.create(params, optimizer)
     else:
-        params = jax.tree.map(jnp.asarray, restored["params"])
-    state = TrainState.create(params, optimizer)
+        # shaved state_build: the checkpoint carries the WHOLE train
+        # state (params + optax slots + step), so nothing re-inits
+        # eagerly and all leaf conversions ride one batched
+        # device_put instead of a per-leaf jnp.asarray chain
+        state = restore_train_state(optimizer, restored["state"])
 
 _first_step = [True]
 def run_step(state, batch):
@@ -152,8 +157,10 @@ with prof.phase("loop_setup"):
     batch = place_batch()
 
 def after_step():
-    # identical checkpoint cadence for both loop flavours
-    sd = {"params": state.params, "trainer": trainer.state_dict()}
+    # identical checkpoint cadence for both loop flavours; the FULL
+    # train state rides the snapshot so a restore supplies the optax
+    # slots and state_build defers the optimizer init
+    sd = {"state": state, "trainer": trainer.state_dict()}
     if DISK_EVERY and trainer.global_step % DISK_EVERY == 0:
         # durable mid-run save; wait for the commit so a kill rule
         # scheduled a couple of steps later deterministically finds
@@ -229,7 +236,7 @@ else:
 # committed anyway.  Only node rank 0 waits on the commit tracker —
 # the saver writes it on rank 0 alone, so in multi-agent runs the
 # other ranks persist their shard and exit
-final_sd = {"params": state.params, "trainer": trainer.state_dict()}
+final_sd = {"state": state, "trainer": trainer.state_dict()}
 NODE_RANK = int(os.environ.get("DLROVER_NODE_RANK", "0") or 0)
 if NODE_RANK == 0:
     deadline = time.time() + 60
@@ -549,6 +556,98 @@ for k in range(start_step, TOTAL_STEPS):
             )
 
 final_sd = {"dense": state, "trainer": trainer.state_dict()}
+deadline = time.time() + 60
+while time.time() < deadline and committed_step() < TOTAL_STEPS:
+    ckpt.save_checkpoint(
+        TOTAL_STEPS, final_sd, storage_type=StorageType.DISK,
+    )
+    ckpt.wait()
+    poll_end = time.time() + 10
+    while time.time() < poll_end and committed_step() < TOTAL_STEPS:
+        time.sleep(0.2)
+assert committed_step() >= TOTAL_STEPS, (
+    "checkpoint commit did not land"
+)
+ckpt.close()
+'''
+
+
+# Streaming-reshard kill loop (ISSUE 14): a WORLD-1 job whose
+# checkpoint dir was PRE-SEEDED by the harness with a committed
+# world-2 sparse checkpoint.  The very first restore is therefore a
+# cross-world STREAMING reshard — `kv.reshard_chunk` fires once per
+# window, and the scenario SIGKILLs the worker mid-stream.  Committed
+# storage is untouched by the partial reshard (it only mutates
+# in-process tables), so the replacement replays the identical
+# reshard from the same shards and trains to completion; the
+# exactly-once digests are checked against the seeder's JSON.
+# argv: ckpt_dir
+SPARSE_RESHARD_TRAIN_SCRIPT = r'''
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+from dlrover_tpu.ops.kv_variable import GroupAdamOptimizer, KvVariable
+from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+ckpt_dir = sys.argv[1]
+TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "10"))
+CKPT_EVERY = int(os.environ.get("DLROVER_CHAOS_CKPT_EVERY", "2"))
+STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
+DIM = int(os.environ.get("DLROVER_CHAOS_RESHARD_KV_DIM", "16"))
+
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+
+def committed_step():
+    try:
+        with open(tracker) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
+
+table = KvVariable(dim=DIM, seed=17, name="emb")
+kv_opt = GroupAdamOptimizer(table, learning_rate=5e-3)
+adapter = SparseStateAdapter()
+adapter.register_optimizer(kv_opt)
+ckpt = Checkpointer(ckpt_dir)
+ckpt.register_sparse(adapter)
+
+# the seeded checkpoint is stamped world 2, this job is world 1: the
+# load below IS the streaming reshard (kv.reshard_chunk per window —
+# the kill rule lands here in incarnation 0, before any train step)
+step0, restored = ckpt.load_checkpoint()
+assert step0 is not None, "pre-seeded world-2 checkpoint missing"
+start_step = int(step0)
+w = jnp.asarray(np.asarray(restored["w"], dtype=np.float32))
+
+trainer = ElasticTrainer(global_batch_size=8, micro_batch_size=8,
+                         dp_size=1)
+trainer.global_step = start_step
+
+for k in range(start_step, TOTAL_STEPS):
+    krng = np.random.default_rng(5_000 + k)
+    keys = krng.integers(0, 1_200, 64).astype(np.int64)
+    with trainer.profile("h2d"):
+        emb = table.gather(keys)
+    with trainer.profile("compute") as p:
+        kv_opt.apply_gradients(keys, np.tanh(emb) * 0.1)
+        w = w * 0.9
+        p.block(w)
+    trainer.report_step({"loss": float(jnp.sum(w))})
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+    with trainer.profile("checkpoint"):
+        if trainer.global_step % CKPT_EVERY == 0:
+            ckpt.save_checkpoint(
+                trainer.global_step, {"w": np.asarray(w)},
+                storage_type=StorageType.MEMORY,
+            )
+
+final_sd = {"w": np.asarray(w)}
 deadline = time.time() + 60
 while time.time() < deadline and committed_step() < TOTAL_STEPS:
     ckpt.save_checkpoint(
@@ -1405,6 +1504,32 @@ def sparse_resize_churn(seed: int = 71) -> Scenario:
     })
 
 
+def sparse_streaming_reshard_kill(seed: int = 79) -> Scenario:
+    """Streaming-reshard crash consistency (ISSUE 14): the harness
+    pre-seeds a committed world-2 sparse checkpoint, the world-1
+    job's first restore streams the cross-world reshard in bounded
+    windows, and the worker is SIGKILLed on the 3rd
+    ``kv.reshard_chunk`` — mid-stream, tables half-imported.
+    Committed storage is untouched (the reshard mutates only
+    in-process tables), so the replacement replays the identical
+    reshard from the same shards; the additive per-table digests on
+    its resharded restore event must equal the seeder's per-shard
+    export sums with imported rows == the distinct union — no row
+    lost, no chunk double-imported."""
+    return Scenario.from_dict({
+        "name": "sparse-streaming-reshard-kill",
+        "seed": seed,
+        "rules": [{
+            "name": "kill-mid-reshard",
+            "point": "kv.reshard_chunk",
+            "action": "kill",
+            "after_calls": 3,
+            "max_count": 1,
+            "only_first_incarnation": True,
+        }],
+    })
+
+
 def serving_replica_kill_midingest(seed: int = 83) -> Scenario:
     """Serving-plane replica recovery (ISSUE 13): SIGKILL the serving
     replica INSIDE a generation apply (the ``serving.ingest`` hook
@@ -1538,6 +1663,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "sparse_kill_restore": sparse_kill_restore,
     "sparse_spill_io_error": sparse_spill_io_error,
     "sparse_resize_churn": sparse_resize_churn,
+    "sparse_streaming_reshard_kill": sparse_streaming_reshard_kill,
     "serving_replica_kill_midingest": serving_replica_kill_midingest,
     "serving_trainer_kill_midpublish": (
         serving_trainer_kill_midpublish
@@ -1691,6 +1817,21 @@ RUN_OPTIONS: Dict[str, Dict] = {
             "DLROVER_KV_DIGEST": "1",
             "DLROVER_CHAOS_PUB_EVERY": "2",
             "DLROVER_CHAOS_STEP_SLEEP": "0.2",
+        },
+    },
+    # streaming reshard: the harness pre-seeds a committed world-2
+    # sparse checkpoint at step 4 (seed_kv_world), the window is
+    # pinned to 200 rows so the ~600-row-per-rank tables stream in
+    # several chunks (the kill rule needs a 3rd chunk to land on),
+    # and digests are armed for the exactly-once verdict
+    "sparse-streaming-reshard-kill": {
+        "total_steps": 10,
+        "ckpt_every": 2,
+        "train_script": "sparse_reshard",
+        "seed_kv_world": 2,
+        "extra_env": {
+            "DLROVER_KV_DIGEST": "1",
+            "DLROVER_KV_RESHARD_WINDOW_ROWS": "200",
         },
     },
     # spill-disk death mid-export: same loop + budget; the kill lands

@@ -28,7 +28,7 @@ from dlrover_tpu.checkpoint.saver import (
 import numpy as np
 
 from dlrover_tpu.checkpoint.sharded import SHARD_SEP
-from dlrover_tpu.checkpoint.sparse import KV_STATE_KEY
+from dlrover_tpu.checkpoint.sparse import KV_META_KEY, KV_STATE_KEY
 from dlrover_tpu.checkpoint.shm_handler import (
     CheckpointConfig,
     SharedMemoryHandler,
@@ -150,6 +150,7 @@ class CheckpointEngine:
         # imports (or cross-world reshards) the blobs back before the
         # dense state is returned
         self._sparse = None
+        self._warned_keep_latest = False
         self._notified_agent = False
         self._deletion_keep_latest = deletion_keep_latest
         self._cached_step = -1
@@ -211,13 +212,21 @@ class CheckpointEngine:
             )
         self._sparse = adapter
 
-    def _merge_sparse(self, state_dict, step: int):
+    def _merge_sparse(self, state_dict, step: int,
+                      durable: bool = False):
         """Fold the adapter's export snapshot into a COPY of the
         state dict.  Runs synchronously with respect to table
         mutation (before the async writer takes over), so the sparse
         snapshot is consistent with the dense one: the save stall
         grows only by the export memcpy — the tables are host RAM
-        already, there is no device fetch to wait on."""
+        already, there is no device fetch to wait on.
+
+        ``durable`` marks a save headed for a committed storage step
+        dir: with delta checkpoints enabled the adapter then exports
+        only the rows touched since the previous durable export
+        (periodic full bases, chain metadata under the kv subtree);
+        memory-only saves always export full state — the shm segment
+        holds exactly one snapshot and must stand alone."""
         if self._sparse is None:
             return state_dict
         if not isinstance(state_dict, dict):
@@ -228,9 +237,25 @@ class CheckpointEngine:
             )
         if KV_STATE_KEY in state_dict:
             return state_dict
+        if durable and self._sparse.delta_checkpoints_enabled() and (
+            # the newest delta's chain spans at most full_every
+            # committed steps (base included), so keep_latest >=
+            # full_every retains every link — the documented contract
+            0 < self._deletion_keep_latest
+            < self._sparse.delta_full_every()
+        ) and not self._warned_keep_latest:
+            self._warned_keep_latest = True
+            logger.warning(
+                "delta flash checkpoints need every chain link on "
+                "storage, but deletion_keep_latest=%d < full_every="
+                "%d — a pruned link breaks restore; raise "
+                "keep_latest or lower full_every",
+                self._deletion_keep_latest,
+                self._sparse.delta_full_every(),
+            )
         merged = dict(state_dict)
-        merged[KV_STATE_KEY] = self._sparse.export_state(
-            step=step, rank=self._rank
+        merged[KV_STATE_KEY] = self._sparse.export_for_checkpoint(
+            step=step, rank=self._rank, durable=durable
         )
         return merged
 
@@ -285,7 +310,7 @@ class CheckpointEngine:
 
     def save_to_memory(
         self, step: int, state_dict, path: str = "",
-        block_lock: bool = False,
+        block_lock: bool = False, durable: bool = False,
     ) -> bool:
         """Synchronous part of a flash save: device->host copy into
         shm under the shm lock.  Non-blocking lock by default: if the
@@ -299,7 +324,12 @@ class CheckpointEngine:
         # sparse tables export here on the SYNC path (MEMORY saves /
         # no-device-array states); the async path already merged a
         # consistent export before queueing, which the key guard skips
-        state_dict = self._merge_sparse(state_dict, step)
+        merged_here = (
+            self._sparse is not None
+            and isinstance(state_dict, dict)
+            and KV_STATE_KEY not in state_dict
+        )
+        state_dict = self._merge_sparse(state_dict, step, durable)
         # every rank locks its shard: the agent's breakpoint save reads
         # all local shards, so an unlocked write can be torn even for
         # ranks that never persist to storage; without an agent there
@@ -316,6 +346,11 @@ class CheckpointEngine:
                     step,
                 )
                 _SAVE_SKIPPED_TOTAL.inc(reason="saver_busy")
+                if merged_here and durable:
+                    # a delta export already DRAINED its baseline;
+                    # the skipped save means those rows never became
+                    # durable — the next export must re-base
+                    self._sparse.checkpoint_chain_poison()
                 return False
             lock_wait = time.perf_counter() - t0
             locked = True
@@ -427,6 +462,10 @@ class CheckpointEngine:
             except Exception as e:  # noqa: BLE001
                 self._last_async_error = e
                 _SAVE_ERRORS_TOTAL.inc()
+                if self._sparse is not None:
+                    # the queued snapshot may hold a drained delta
+                    # that never reached shm — re-base next export
+                    self._sparse.checkpoint_chain_poison()
                 logger.exception(
                     "async snapshot of step %s failed", step
                 )
@@ -470,7 +509,7 @@ class CheckpointEngine:
             # respect to table mutation, like the on-device copy is
             # for the dense leaves; the writer thread must not read a
             # table the next train step is already scattering into
-            snap = self._merge_sparse(snap, step)
+            snap = self._merge_sparse(snap, step, durable=True)
             # kick off the device->host transfers without blocking
             for leaf in jax.tree_util.tree_leaves(snap):
                 if isinstance(leaf, jax.Array):
@@ -481,7 +520,7 @@ class CheckpointEngine:
             self._ensure_writer()
             self._writer_queue.put((step, snap, path, True))
             return True
-        ok = self.save_to_memory(step, state_dict, path)
+        ok = self.save_to_memory(step, state_dict, path, durable=True)
         if ok and self._event_queue is not None:
             self._event_queue.put(
                 CheckpointEvent(
@@ -620,11 +659,93 @@ class CheckpointEngine:
                 "carries no kv state; tables left untouched", step,
             )
             return state
-        info = self._sparse.import_state(
-            kv_state, tier=tier, step=step, rank=self._rank
-        )
-        stats.extra.update(info)
+        self._import_kv_same_world(kv_state, tier, step, stats)
         return state
+
+    def _import_kv_same_world(self, kv_state, tier, step, stats):
+        """Same-world kv import, delta-chain aware: a full/base blob
+        imports verbatim; a delta blob replays its chain — base +
+        intermediate deltas read from the committed storage step dirs
+        named in the link metadata, then the blob in hand.  A broken
+        chain (pruned or never-persisted link) raises: silently
+        restoring partial sparse state would be worse than failing
+        the tier loudly."""
+        meta = kv_state.get(KV_META_KEY)
+        if isinstance(meta, dict) and meta.get("kind") == "delta":
+            want_rank = 0 if self.replicated else self._rank
+            links = self._kv_chain_links(meta, want_rank)
+            if links is None:
+                raise RuntimeError(
+                    f"kv delta checkpoint of step {step} is "
+                    "unusable: a chain link is missing from storage "
+                    "(pruned by deletion_keep_latest, or its persist "
+                    "never committed)"
+                )
+            info = self._sparse.import_chain(
+                links + [kv_state], tier=tier, step=step,
+                rank=self._rank,
+            )
+        else:
+            info = self._sparse.import_state(
+                kv_state, tier=tier, step=step, rank=self._rank
+            )
+        stats.extra.update(info)
+
+    def _read_kv_state_at(self, step: int, rank: int):
+        """One rank's nested kv subtree of a SPECIFIC committed step,
+        as lazy views into the shard's mmap (the streaming import
+        pages in only the window it copies).  None when the step dir
+        or the kv subtree is absent."""
+        from dlrover_tpu.checkpoint.saver import read_checkpoint_at
+        from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+
+        got_step, shards = read_checkpoint_at(
+            self.checkpoint_dir, step, self._storage, only_rank=rank,
+        )
+        if got_step is None or rank not in shards:
+            return None
+        meta, raw = shards[rank]
+        flat, _metas = flat_from_raw(meta, raw, detach=False)
+        kv_flat, _rest = SparseStateAdapter.split_flat(flat)
+        if not kv_flat:
+            return None
+        # the views reference `raw` via .base, so the mapping stays
+        # alive for as long as the caller holds the nested dict
+        return SparseStateAdapter.nest_flat(kv_flat)
+
+    def _kv_chain_links(self, kv_meta, rank: int):
+        """Resolve a delta link's replay prefix (base + intermediate
+        deltas, oldest first) for one rank; None when any link is
+        missing."""
+        from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+
+        links = []
+        for s in SparseStateAdapter.chain_steps(kv_meta):
+            st = self._read_kv_state_at(int(s), rank)
+            if st is None:
+                logger.error(
+                    "kv delta chain broken: step %s has no kv shard "
+                    "for rank %s on storage", s, rank,
+                )
+                return None
+            links.append(st)
+        return links
+
+    def _kv_chains_for(self, nested_per_rank):
+        """{rank: [links..., blob]} for a cross-world streaming
+        reshard, resolving each rank's delta chain; None when any
+        chain is broken."""
+        chains = {}
+        for rank, kv_state in sorted(nested_per_rank.items()):
+            meta = kv_state.get(KV_META_KEY)
+            if isinstance(meta, dict) and meta.get("kind") == "delta":
+                links = self._kv_chain_links(meta, rank)
+                if links is None:
+                    return None
+                chains[rank] = links + [kv_state]
+            else:
+                chains[rank] = [kv_state]
+        return chains
 
     def _checkpoint_world(self, meta) -> Optional[int]:
         """World size stamped on a persisted shard's meta (the
@@ -731,29 +852,50 @@ class CheckpointEngine:
         else:
             logger.warning(
                 "checkpoint step %s is from world %s, this world is "
-                "%s: resharding kv state from %d rank file(s)",
-                step, ckpt_world, self._world_size, len(shards),
+                "%s: streaming-resharding kv state from %d rank "
+                "file(s)", step, ckpt_world, self._world_size,
+                len(shards),
             )
+            from dlrover_tpu.checkpoint.sparse import (
+                SparseStateAdapter,
+            )
+
             dense_rank = (
                 want_rank if want_rank in shards else min(shards)
             )
             kv_per_rank = {}
             state = {}
+            # kv subtrees stay LAZY VIEWS into each shard's mmap —
+            # the streaming reshard copies one window at a time, so
+            # peak extra RAM is O(window), not O(sum of shards).
+            # Only the dense rank's remainder is materialized.
             for rank, (meta, raw) in sorted(shards.items()):
-                rank_state = state_dict_from_raw(
-                    meta, raw, stats=stats
+                flat, metas = flat_from_raw(
+                    meta, raw, detach=False, stats=stats
                 )
-                kv_state = (
-                    rank_state.pop(KV_STATE_KEY, None)
-                    if isinstance(rank_state, dict) else None
-                )
-                if kv_state is not None:
-                    kv_per_rank[rank] = kv_state
+                kv_flat, _rest = SparseStateAdapter.split_flat(flat)
+                if kv_flat:
+                    kv_per_rank[rank] = SparseStateAdapter.nest_flat(
+                        kv_flat
+                    )
                 if rank == dense_rank:
-                    state = rank_state
+                    state = self._detach_dense_flat(
+                        flat, metas, stats
+                    )
             if kv_per_rank:
-                info = self._sparse.import_shards(
-                    kv_per_rank,
+                chains = self._kv_chains_for(kv_per_rank)
+                if chains is None:
+                    # same contract as the load_sharded path: a
+                    # broken chain fails the restore LOUDLY —
+                    # returning "no checkpoint" would silently
+                    # restart the job from scratch
+                    raise RuntimeError(
+                        f"kv delta chain of step {step} is unusable "
+                        "for the cross-world reshard (a link is "
+                        "missing from storage)"
+                    )
+                info = self._sparse.import_shards_streaming(
+                    chains,
                     world_size=self._world_size,
                     rank=self._rank,
                     from_world=ckpt_world,
@@ -772,6 +914,32 @@ class CheckpointEngine:
             step, stats.read_s, stats.assemble_s, stats.workers,
         )
         return step, state
+
+    def _detach_dense_flat(self, flat, metas, stats):
+        """Materialize the dense remainder of a flat VIEW dict (kv
+        entries already split out): array views detach through the
+        staged pipeline, scalars pass through, shard entries
+        assemble — the pieces of ``state_dict_from_raw`` without
+        re-reading (or detaching) the kv blobs."""
+        import time as _time
+
+        from dlrover_tpu.checkpoint.restore import detach_flat
+        from dlrover_tpu.checkpoint.shm_handler import (
+            _assemble_flat,
+            _unflatten_to_nested,
+        )
+
+        views = {
+            k: v for k, v in flat.items()
+            if isinstance(v, np.ndarray) and v.base is not None
+        }
+        out = dict(flat)
+        out.update(detach_flat(views, stats=stats))
+        t0 = _time.perf_counter()
+        out = _assemble_flat(out, metas)
+        if stats is not None:
+            stats.assemble_s += _time.perf_counter() - t0
+        return _unflatten_to_nested(out)
 
     def load_sharded(
         self, target_state, orbax_dir: str = "",
@@ -834,12 +1002,11 @@ class CheckpointEngine:
                             SparseStateAdapter,
                         )
 
-                        info = self._sparse.import_state(
+                        self._import_kv_same_world(
                             SparseStateAdapter.nest_flat(kv_flat),
                             tier="shm", step=config.step,
-                            rank=self._rank,
+                            stats=stats,
                         )
-                        stats.extra.update(info)
                     self._record_restore(
                         "shm", config.step,
                         time.perf_counter() - t0, stats.to_phases(), sp,
@@ -934,8 +1101,10 @@ class CheckpointEngine:
 
     def _import_sharded_kv(self, kv_per_rank, shards, step, stats):
         """kv import for the load_sharded storage tier: own shard
-        verbatim when the world is unchanged and this rank's file
-        exists, the hash-reshard otherwise."""
+        verbatim (chain-replayed when it is a delta link) when the
+        world is unchanged and this rank's file exists, the STREAMING
+        hash-reshard otherwise — the nested values are live views
+        into the shard mmaps, so only one window is ever private."""
         from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
 
         nested = {
@@ -947,15 +1116,21 @@ class CheckpointEngine:
             or len(shards)
         )
         if ckpt_world == self._world_size and self._rank in nested:
-            info = self._sparse.import_state(
+            self._import_kv_same_world(
                 nested[self._rank], tier="storage", step=step,
-                rank=self._rank,
+                stats=stats,
             )
-        else:
-            info = self._sparse.import_shards(
-                nested, world_size=self._world_size, rank=self._rank,
-                from_world=ckpt_world, tier="storage", step=step,
+            return
+        chains = self._kv_chains_for(nested)
+        if chains is None:
+            raise RuntimeError(
+                f"kv delta chain of step {step} is unusable for the "
+                "cross-world reshard (a link is missing from storage)"
             )
+        info = self._sparse.import_shards_streaming(
+            chains, world_size=self._world_size, rank=self._rank,
+            from_world=ckpt_world, tier="storage", step=step,
+        )
         stats.extra.update(info)
 
     def _assemble_to_target(self, target_state, flat, metas, stats=None):

@@ -182,6 +182,26 @@ class StagedRestore:
         futs = [self.submit(fn, item) for item in items]
         return [f.result() for f in futs]
 
+    def map_pipelined(
+        self, fn: Callable, items: Iterable, depth: int = 2,
+    ):
+        """Generator of ``fn(item)`` results in input order with at
+        most ``depth`` calls in flight — the bounded-lookahead shape
+        of the streaming reshard: window k+1's partition runs on the
+        pool while the caller imports window k, and peak memory stays
+        ~``depth`` windows instead of the whole item list.  Serial
+        mode (workers==1) degrades to the exact inline sequence via
+        the lazy inline futures."""
+        from collections import deque
+
+        pending: deque = deque()
+        for item in items:
+            pending.append(self.submit(fn, item))
+            if len(pending) >= max(1, depth):
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+
     # -- chunked detach ----------------------------------------------------
 
     def copy_chunked(self, dst: np.ndarray, src: np.ndarray) -> List:

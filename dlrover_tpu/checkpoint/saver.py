@@ -553,23 +553,49 @@ def read_last_checkpoint(
     sharded re-assembly path genuinely needs them all and leaves it
     None).
     """
+    storage = storage or get_checkpoint_storage(path=checkpoint_dir)
+    tracker = os.path.join(checkpoint_dir, CheckpointConstant.TRACKER_FILE)
+    if not storage.exists(tracker):
+        return None, {}
+    step = int(str(storage.read(tracker, mode="r")).strip())
+    return read_checkpoint_at(
+        checkpoint_dir, step, storage, workers=workers, stats=stats,
+        only_rank=only_rank,
+    )
+
+
+def read_checkpoint_at(
+    checkpoint_dir: str, step: int,
+    storage: Optional[CheckpointStorage] = None,
+    workers: Optional[int] = None, stats=None,
+    only_rank: Optional[int] = None,
+):
+    """Per-rank shard dict of one SPECIFIC committed step (the
+    delta-checkpoint chain replay reads its base and intermediate
+    links this way; :func:`read_last_checkpoint` resolves the tracker
+    and delegates here).  Returns ``(step, {rank: (meta, raw)})`` or
+    ``(None, {})`` when the step dir is absent."""
     import time as _time
 
     from dlrover_tpu.checkpoint.restore import StagedRestore
 
     t0 = _time.perf_counter()
     storage = storage or get_checkpoint_storage(path=checkpoint_dir)
-    tracker = os.path.join(checkpoint_dir, CheckpointConstant.TRACKER_FILE)
-    if not storage.exists(tracker):
-        return None, {}
-    step = int(str(storage.read(tracker, mode="r")).strip())
     step_dir = os.path.join(checkpoint_dir, step_dirname(step))
-    names = [
-        fname for fname in storage.listdir(step_dir)
-        if fname.startswith("rank_") and fname.endswith(".ckpt")
-    ]
+    try:
+        names = [
+            fname for fname in storage.listdir(step_dir)
+            if fname.startswith("rank_") and fname.endswith(".ckpt")
+        ]
+    except OSError:
+        return None, {}
     if only_rank is not None:
         names = [f for f in names if f == shard_file(only_rank)]
+    # an empty shard set for a LISTABLE step dir still returns the
+    # step with {} — a caller narrowing to only_rank relies on that
+    # to notice "the step exists but not my shard" and fall back to
+    # the all-ranks read (the cross-world sparse reshard's trigger);
+    # only a missing dir (pruned chain link) reads as None above
 
     def _one(fname: str):
         rank = int(fname[len("rank_"):-len(".ckpt")])

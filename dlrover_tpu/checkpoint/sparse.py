@@ -48,6 +48,10 @@ import numpy as np
 
 from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.ops.kv_variable import (
+    DIRTY_CONSUMER_CHECKPOINT,
+    DIRTY_CONSUMER_SERVING,
+)
 from dlrover_tpu.telemetry.events import emit_event
 from dlrover_tpu.telemetry.metrics import get_registry
 
@@ -57,6 +61,37 @@ KV_STATE_KEY = "__kv__"
 KV_PREFIX = KV_STATE_KEY + "/"
 # nested key holding non-table optimizer state (step counters)
 SCALARS_KEY = "__scalars__"
+# nested key carrying the delta-checkpoint link metadata (kind =
+# base/delta, parent/base steps, the chain of steps to replay); the
+# chain is a comma-joined string so it survives the pytree flatten as
+# one scalar
+KV_META_KEY = "__meta__"
+
+# streaming-reshard window: the peak value-row memory any bulk sparse
+# path may hold at once.  MB knob for production, ROWS override for
+# tests/chaos (tiny tables need sub-MB windows to exercise chunking)
+RESHARD_WINDOW_MB_ENV = "DLROVER_KV_RESHARD_WINDOW_MB"
+RESHARD_WINDOW_ROWS_ENV = "DLROVER_KV_RESHARD_WINDOW_ROWS"
+_DEFAULT_RESHARD_WINDOW_MB = 64.0
+
+
+def reshard_window_rows(row_bytes: int) -> int:
+    """Rows per streaming window for a table whose rows cost
+    ``row_bytes`` (keys + values + freq)."""
+    rows = os.environ.get(RESHARD_WINDOW_ROWS_ENV, "").strip()
+    if rows:
+        try:
+            return max(1, int(rows))
+        except ValueError:
+            pass
+    try:
+        mb = float(
+            os.environ.get(RESHARD_WINDOW_MB_ENV, "").strip()
+            or _DEFAULT_RESHARD_WINDOW_MB
+        )
+    except ValueError:
+        mb = _DEFAULT_RESHARD_WINDOW_MB
+    return max(1, int(mb * 2**20 / max(1, row_bytes)))
 
 _REG = get_registry()
 _KV_CKPT_SECONDS = _REG.histogram(
@@ -162,6 +197,16 @@ class SparseStateAdapter:
         self._tables: Dict[str, Any] = {}
         self._optimizers: List[Any] = []
         self._digest = digest
+        # delta flash checkpoints (None = full exports, the default):
+        # every `_delta_every`th durable export is a full base, the
+        # rest export only the consumer-1 dirty rows; `_ckpt_chain`
+        # is the step chain a restore replays, `_ckpt_poisoned`
+        # forces the next export to re-base (fresh adapter, restore,
+        # or a failed/skipped save whose drained delta never became
+        # durable)
+        self._delta_every: Optional[int] = None
+        self._ckpt_chain: List[int] = []
+        self._ckpt_poisoned = True
 
     # -- registration -------------------------------------------------------
 
@@ -199,6 +244,7 @@ class SparseStateAdapter:
 
     def export_state(
         self, step: Optional[int] = None, rank: Optional[int] = None,
+        extra_event: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Snapshot every registered table into plain numpy blobs
         (spilled rows included — ``KvVariable.export`` covers both
@@ -271,26 +317,40 @@ class SparseStateAdapter:
             event["lost_rows"] = int(lost)
         if digests:
             event["digests"] = digests
+        if extra_event:
+            event.update(extra_event)
         emit_event("kv_checkpoint", **event)
         return out
 
     # -- delta export (serving-plane incremental publication) ---------------
 
-    def enable_dirty_tracking(self) -> "SparseStateAdapter":
-        """Arm dirty/dead tracking on every registered table (the
-        serving publisher calls this at construction — tracking is
-        opt-in so non-publishing jobs pay nothing)."""
+    def enable_dirty_tracking(
+        self, consumer: int = DIRTY_CONSUMER_SERVING
+    ) -> "SparseStateAdapter":
+        """Arm dirty/dead tracking for one consumer slot on every
+        registered table (the serving publisher arms the serving
+        slot at construction; :meth:`enable_delta_checkpoints` arms
+        the checkpoint slot — tracking is opt-in so non-publishing
+        jobs pay nothing, and the two planes baseline
+        independently)."""
         for table in self._tables.values():
-            table.enable_dirty_tracking()
+            table.enable_dirty_tracking(consumer)
         return self
 
-    def dirty_rows(self) -> int:
-        """Rows the next delta would carry, summed over tables."""
-        return sum(t.dirty_count() for t in self._tables.values())
+    def dirty_rows(
+        self, consumer: int = DIRTY_CONSUMER_SERVING
+    ) -> int:
+        """Rows the consumer's next delta would carry, summed over
+        tables."""
+        return sum(
+            t.dirty_count(consumer) for t in self._tables.values()
+        )
 
     def export_delta(
         self, step: Optional[int] = None, rank: Optional[int] = None,
         clear: bool = True,
+        consumer: int = DIRTY_CONSUMER_SERVING,
+        extra_event: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Snapshot only the rows TOUCHED since the last cleared
         delta (plus deletion tombstones) — the export stall is
@@ -320,8 +380,10 @@ class SparseStateAdapter:
             # re-touched keys legitimately appear in both lists and
             # the apply order (delete, then import) lands them alive
             # with the new value — same as the trainer.
-            dead = table.export_dead(clear=clear)
-            keys, values, freq = table.export_dirty(clear=clear)
+            dead = table.export_dead(clear=clear, consumer=consumer)
+            keys, values, freq = table.export_dirty(
+                clear=clear, consumer=consumer
+            )
             out[name] = {
                 "keys": keys, "values": values, "freq": freq,
                 "dead": dead,
@@ -360,6 +422,8 @@ class SparseStateAdapter:
             event["rank"] = int(rank)
         if digests:
             event["digests"] = digests
+        if extra_event:
+            event.update(extra_event)
         emit_event("kv_checkpoint", **event)
         return out
 
@@ -434,6 +498,141 @@ class SparseStateAdapter:
         emit_event("kv_checkpoint", **event)
         return {"kv_s": round(seconds, 4), "kv_rows": int(rows)}
 
+    # -- delta-aware flash checkpoints (hot save path) ----------------------
+
+    def enable_delta_checkpoints(
+        self, full_every: int = 8
+    ) -> "SparseStateAdapter":
+        """Make durable flash saves INCREMENTAL: every
+        ``full_every``th export is a full base, the rest carry only
+        the rows touched since the previous durable export — the
+        save stall becomes O(rows touched), the PR 13 serving result
+        applied to the fault-tolerance plane.  The baseline lives in
+        the CHECKPOINT consumer slot, so the serving publisher's
+        deltas and these never clear each other.
+
+        Restores replay the chain (base + deltas, read from the
+        committed storage step dirs named in the link metadata), so
+        every link must stay on storage: run with
+        ``deletion_keep_latest=0`` or ``>= full_every``.  Memory-only
+        (shm) saves always export full state — the shm segment holds
+        exactly one snapshot and must stand alone."""
+        self._delta_every = max(1, int(full_every))
+        self._ckpt_poisoned = True
+        self.enable_dirty_tracking(DIRTY_CONSUMER_CHECKPOINT)
+        return self
+
+    def delta_checkpoints_enabled(self) -> bool:
+        return self._delta_every is not None
+
+    def delta_full_every(self) -> int:
+        """Base cadence of the delta-checkpoint chain (0 when delta
+        checkpoints are off) — the longest chain a restore replays,
+        and the minimum ``deletion_keep_latest`` that keeps every
+        link on storage."""
+        return int(self._delta_every or 0)
+
+    def checkpoint_chain_poison(self) -> None:
+        """Force the next durable export to re-base.  Called when an
+        export's save was skipped or failed AFTER the delta drained
+        its baseline — those rows would otherwise silently drop out
+        of the chain (same discipline as the serving publisher's
+        poisoned chain)."""
+        self._ckpt_poisoned = True
+
+    def export_for_checkpoint(
+        self, step: Optional[int] = None, rank: Optional[int] = None,
+        durable: bool = True,
+    ) -> Dict[str, Any]:
+        """The engine's save-path entry: a full export unless delta
+        checkpoints are enabled AND this save is durable (persisted
+        to a storage step dir a restore can chain through).  Link
+        metadata rides under :data:`KV_META_KEY`."""
+        if self._delta_every is None or not durable:
+            return self.export_state(step=step, rank=rank)
+        step_i = int(step) if step is not None else 0
+        # a table registered after the last base has no tracked
+        # history — re-base so its rows enter the chain at all
+        untracked = any(
+            not t.dirty_tracking_enabled(DIRTY_CONSUMER_CHECKPOINT)
+            for t in self._tables.values()
+        )
+        self.enable_dirty_tracking(DIRTY_CONSUMER_CHECKPOINT)
+        if (
+            untracked
+            or self._ckpt_poisoned
+            or not self._ckpt_chain
+            or len(self._ckpt_chain) >= self._delta_every
+        ):
+            # baseline BEFORE the export (the publisher's ordering):
+            # a mutation racing the two steps lands in the base AND
+            # the next delta — a benign overwrite, never a silent gap
+            for table in self._tables.values():
+                table.clear_dirty(DIRTY_CONSUMER_CHECKPOINT)
+            out = self.export_state(
+                step=step, rank=rank,
+                extra_event={"kind": "base",
+                             "consumer": DIRTY_CONSUMER_CHECKPOINT},
+            )
+            out[KV_META_KEY] = {"kind": "base", "step": step_i}
+            self._ckpt_chain = [step_i]
+            self._ckpt_poisoned = False
+            return out
+        out = self.export_delta(
+            step=step, rank=rank, clear=True,
+            consumer=DIRTY_CONSUMER_CHECKPOINT,
+            extra_event={
+                "kind": "delta",
+                "consumer": DIRTY_CONSUMER_CHECKPOINT,
+                "base_step": int(self._ckpt_chain[0]),
+                "parent_step": int(self._ckpt_chain[-1]),
+                "chain_len": len(self._ckpt_chain) + 1,
+            },
+        )
+        out[KV_META_KEY] = {
+            "kind": "delta",
+            "step": step_i,
+            "parent": int(self._ckpt_chain[-1]),
+            "base": int(self._ckpt_chain[0]),
+            # comma-joined so the pytree flatten keeps it one scalar
+            "chain": ",".join(str(s) for s in self._ckpt_chain),
+        }
+        self._ckpt_chain.append(step_i)
+        return out
+
+    @staticmethod
+    def chain_steps(meta: Dict[str, Any]) -> List[int]:
+        """The storage steps a delta link's restore must replay
+        BEFORE the link itself (base first)."""
+        raw = str(meta.get("chain", "") or "")
+        return [int(s) for s in raw.split(",") if s.strip()]
+
+    def import_chain(
+        self, links: List[Dict], tier: str = "",
+        step: Optional[int] = None, rank: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Chain replay: ``links[0]`` (a base / full export) replaces
+        the tables, every later link applies as a delta (tombstones
+        then rows).  Digest-equal to a full export at every link —
+        the property test pins it."""
+        if not links:
+            return {"kv_s": 0.0, "kv_rows": 0}
+        t0 = time.perf_counter()
+        info = self.import_state(
+            links[0], tier=tier, step=step, rank=rank
+        )
+        rows = int(info.get("kv_rows", 0))
+        for link in links[1:]:
+            d = self.apply_delta(
+                link, tier=tier, step=step, rank=rank
+            )
+            rows += int(d.get("kv_rows", 0))
+        return {
+            "kv_s": round(time.perf_counter() - t0, 4),
+            "kv_rows": rows,
+            "kv_chain": len(links),
+        }
+
     # -- import (restore path) ----------------------------------------------
 
     def _import_tables(
@@ -442,6 +641,10 @@ class SparseStateAdapter:
     ) -> Tuple[int, int, Dict[str, Dict[str, Any]]]:
         """Replace every registered table's contents; returns
         (rows, bytes, digests)."""
+        # any restore invalidates the delta-checkpoint baseline: the
+        # import re-marks every row dirty anyway, and a delta chained
+        # onto pre-restore history would be wrong — next export bases
+        self._ckpt_poisoned = True
         with_digest = self.digest_enabled()
         rows = nbytes = 0
         digests: Dict[str, Dict[str, Any]] = {}
@@ -609,6 +812,238 @@ class SparseStateAdapter:
             "kv_s": round(seconds, 4),
             "kv_rows": int(rows),
             "kv_resharded": True,
+        }
+
+    # -- streaming reshard (bounded-memory cross-world restore) -------------
+
+    def import_shards_streaming(
+        self,
+        shards: Dict[int, Any],
+        world_size: int,
+        rank: int,
+        from_world: Optional[int] = None,
+        tier: str = "storage",
+        step: Optional[int] = None,
+        window_rows: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Cross-world reshard that never holds more than a bounded
+        window of value rows in RAM: per old rank, per table, the
+        source arrays (typically live mmap/shm VIEWS — only the
+        window pages in) are walked in ``window_rows`` slices, each
+        window vectorized through :func:`owner_of_keys`, and exactly
+        this rank's owned subset imported.  Window k+1's
+        partition/copy runs on the staged-restore pool while window
+        k's native import holds the table lock (ctypes releases the
+        GIL), so partition and import overlap.
+
+        ``shards`` maps old rank -> nested kv state OR a LIST of
+        states (a delta-checkpoint chain, base first: later links
+        overwrite/tombstone earlier ones exactly as replay would).
+        Ranks apply in ascending order, so duplicate keys keep the
+        one-shot path's last-rank-wins overwrite semantics.
+
+        With digests armed, the per-window import digests are summed
+        additively and checked against a chunked re-export of the
+        final tables — a chunk imported twice (or a row lost between
+        windows) breaks the equality, so exactly-once holds at ANY
+        chunking.  (Chain inputs skip the strict check: a delta
+        legitimately overwrites its base's rows.)"""
+        from dlrover_tpu.checkpoint.restore import StagedRestore
+
+        t0 = time.perf_counter()
+        if from_world is None:
+            from_world = len(shards)
+        with_digest = self.digest_enabled()
+        chains: Dict[int, List[Dict]] = {
+            r: (list(state) if isinstance(state, (list, tuple))
+                else [state])
+            for r, state in sorted(shards.items())
+        }
+        chained = any(len(links) > 1 for links in chains.values())
+        # replace-semantics: clear every registered table up front (a
+        # leftover row from the previous world would be a phantom
+        # duplicate of a row the partition assigned elsewhere), then
+        # pre-size for the expected owned share — geometric slab
+        # growth mid-stream would otherwise realloc+memcpy the whole
+        # destination repeatedly, exactly the transient the bounded
+        # window exists to avoid
+        for name, table in self._tables.items():
+            table.clear()
+            est = 0
+            for links in chains.values():
+                sub = links[0].get(name)
+                if isinstance(sub, dict) and sub.get(
+                    "keys"
+                ) is not None:
+                    est += int(np.asarray(sub["keys"]).shape[0])
+            if est:
+                table.reserve(est // max(1, world_size) + 64)
+        self._ckpt_poisoned = True
+
+        rows = nbytes = total_rows = chunks = 0
+        import_sums: Dict[str, int] = {}
+        win_used: Optional[int] = None
+
+        def _tasks():
+            """(table, kind, key_slice, value_slice, freq_slice)
+            windows, ranks ascending, links in chain order, dead
+            before rows within a link (the apply_delta ordering)."""
+            nonlocal win_used
+            for old_rank, links in chains.items():
+                for link in links:
+                    for name, table in self._tables.items():
+                        sub = link.get(name)
+                        if not isinstance(sub, dict):
+                            continue
+                        win = window_rows or reshard_window_rows(
+                            table.dim * 4 + 16
+                        )
+                        win_used = win
+                        dead = sub.get("dead")
+                        if dead is not None and len(dead):
+                            for lo in range(0, len(dead), win):
+                                yield (
+                                    name, "dead",
+                                    dead[lo:lo + win], None, None,
+                                )
+                        keys = sub.get("keys")
+                        if keys is None:
+                            continue
+                        n = int(np.asarray(keys).shape[0])
+                        for lo in range(0, n, win):
+                            hi = min(n, lo + win)
+                            yield (
+                                name, "rows", keys[lo:hi],
+                                sub["values"], (sub["freq"], lo, hi),
+                            )
+
+        def _prepare(task):
+            """Window copy + ownership partition (pool thread, numpy
+            only).  Only the window's KEY column (8 B/row) and the
+            OWNED value/freq rows ever materialize private — the
+            value rows are fancy-indexed straight off the (possibly
+            mmap) source view, so the per-window transient is
+            ~window/world_size of value bytes, not a full window
+            copy."""
+            name, kind, keys_v, values_v, freq_ref = task
+            keys = np.ascontiguousarray(keys_v, dtype=np.int64)
+            mine = owner_of_keys(keys, world_size) == rank
+            if kind == "dead":
+                return name, kind, keys[mine], None, None, len(keys)
+            freq_v, lo, hi = freq_ref
+            dim = self._tables[name].dim
+            idx = lo + np.flatnonzero(mine)
+            values = np.ascontiguousarray(
+                np.asarray(values_v).reshape(-1, dim)[idx],
+                dtype=np.float32,
+            )
+            freq = np.ascontiguousarray(
+                np.asarray(freq_v)[idx], dtype=np.uint64
+            )
+            return name, kind, keys[mine], values, freq, len(keys)
+
+        with StagedRestore() as staged:
+            for prepared in staged.map_pipelined(
+                _prepare, _tasks(), depth=2
+            ):
+                name, kind, keys, values, freq, n_in = prepared
+                chunks += 1
+                # chaos hook: a kill here is a worker dying
+                # MID-STREAMING-RESHARD — committed storage is
+                # untouched (this path only mutates in-process
+                # tables), so the replacement replays the identical
+                # reshard from the same shards
+                _chaos.fire("kv.reshard_chunk", step=chunks)
+                table = self._tables[name]
+                if kind == "dead":
+                    if keys.size:
+                        table.delete(keys)
+                    continue
+                total_rows += n_in
+                if keys.size:
+                    table.import_(keys, values, freq)
+                    rows += int(keys.size)
+                    nbytes += (
+                        keys.nbytes + values.nbytes + freq.nbytes
+                    )
+                    if with_digest and not chained:
+                        import_sums[name] = (
+                            import_sums.get(name, 0)
+                            + rows_digest(keys, values, freq)
+                        ) % (1 << 64)
+                emit_event(
+                    "kv_reshard_chunk",
+                    table=name, chunk=chunks, rows=int(n_in),
+                    owned=int(keys.size), rank=int(rank),
+                    step=int(step) if step is not None else None,
+                )
+
+        digests: Dict[str, Dict[str, Any]] = {}
+        if with_digest:
+            win = win_used or 65536
+            for name, table in self._tables.items():
+                final = 0
+                n_rows = 0
+                for k, v, f in table.export_chunks(win):
+                    final = (
+                        final + rows_digest(k, v, f)
+                    ) % (1 << 64)
+                    n_rows += len(k)
+                digests[name] = {
+                    "rows": int(n_rows), "sum": f"{final:016x}",
+                }
+                if not chained and name in import_sums and (
+                    final != import_sums[name]
+                ):
+                    raise RuntimeError(
+                        f"streaming reshard of table {name!r} is not "
+                        f"exactly-once: additive import digest "
+                        f"{import_sums[name]:016x} != final table "
+                        f"digest {final:016x} (a chunk was imported "
+                        f"twice or a row was lost between windows)"
+                    )
+        # optimizer scalars from the lowest old rank's LAST link
+        scalars = None
+        for _r, links in chains.items():
+            sc = links[-1].get(SCALARS_KEY)
+            if sc:
+                scalars = sc
+                break
+        if scalars:
+            for opt in self._optimizers:
+                sc = scalars.get(_enc(opt.table.name))
+                if sc and hasattr(opt, "load_state_scalars"):
+                    opt.load_state_scalars(sc)
+        seconds = time.perf_counter() - t0
+        _KV_CKPT_SECONDS.observe(seconds, stage="reshard")
+        event = dict(
+            stage="restore", rows=int(rows), bytes=int(nbytes),
+            seconds=round(seconds, 4), tables=len(self._tables),
+            resharded=True, from_world=int(from_world),
+            world_size=int(world_size), total_rows=int(total_rows),
+            tier=tier, streamed=True, chunks=int(chunks),
+        )
+        if win_used is not None:
+            event["window_rows"] = int(win_used)
+        if step is not None:
+            event["step"] = int(step)
+        event["rank"] = int(rank)
+        if digests:
+            event["digests"] = digests
+        emit_event("kv_checkpoint", **event)
+        logger.info(
+            "streaming kv reshard: %d/%d row(s) owned by rank %d of "
+            "world %d (from world %s, %d chunk(s) of %s row(s), "
+            "%.3fs, %.1f MB/s)",
+            rows, total_rows, rank, world_size, from_world, chunks,
+            win_used, seconds,
+            (nbytes / 2**20 / seconds) if seconds > 0 else 0.0,
+        )
+        return {
+            "kv_s": round(seconds, 4),
+            "kv_rows": int(rows),
+            "kv_resharded": True,
+            "kv_chunks": int(chunks),
         }
 
     # -- flat-key helpers (engine's load_sharded path) ----------------------

@@ -80,3 +80,66 @@ def get_job_name() -> str:
 
 def get_restart_count() -> int:
     return _get_int(NodeEnv.RESTART_COUNT)
+
+
+def process_rss_bytes(pid: str = "self") -> int:
+    """Current resident set size of ``pid`` from /proc (0 when
+    unreadable) — the raw sample the memory-bound guards and the
+    sparse-scale bench monitor."""
+    try:
+        with open(f"/proc/{pid}/statm", "rb") as f:
+            fields = f.read().split()
+        return int(fields[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+class PeakRssSampler:
+    """Background sampler of this process's peak RSS over a scoped
+    region (``with PeakRssSampler() as s: ... ; s.peak_extra_bytes``).
+
+    VmHWM would be the exact kernel answer but cannot be reset
+    portably (gVisor rejects the clear_refs write), so a ~1 ms
+    sampling thread approximates the peak; allocation spikes held for
+    O(window-import) or longer — exactly what the bounded-memory
+    reshard guard bounds — are far wider than the sampling period.
+    ``peak_extra_bytes`` is the peak minus the baseline taken at
+    enter."""
+
+    def __init__(self, interval_s: float = 0.001):
+        self.interval_s = interval_s
+        self.baseline = 0
+        self.peak = 0
+        self._stop = None
+        self._thread = None
+
+    def __enter__(self) -> "PeakRssSampler":
+        import threading
+
+        self.baseline = self.peak = process_rss_bytes()
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.is_set():
+                rss = process_rss_bytes()
+                if rss > self.peak:
+                    self.peak = rss
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="peak-rss-sampler"
+        )
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        rss = process_rss_bytes()
+        if rss > self.peak:
+            self.peak = rss
+        return False
+
+    @property
+    def peak_extra_bytes(self) -> int:
+        return max(0, self.peak - self.baseline)

@@ -101,20 +101,32 @@ struct Table {
   // optimizer hot path, nor accumulate a never-drained dirty set
   // that converges to the full key space (~40-50 B/key of permanent
   // overhead on a multi-GB table).
-  bool track_dirty = false;
-  std::unordered_set<int64_t> dirty;
-  std::unordered_set<int64_t> dead;
+  //
+  // PER-CONSUMER baselines: the serving publisher (consumer 0) and
+  // the delta flash checkpointer (consumer 1) drain their deltas on
+  // independent cadences — one shared set would let either plane
+  // silently clear rows out of the other's next delta.  Each
+  // consumer arms and clears only its own slot; mutations mark every
+  // armed slot.
+  static constexpr int kDirtyConsumers = 2;
+  bool track_dirty[kDirtyConsumers] = {false, false};
+  std::unordered_set<int64_t> dirty[kDirtyConsumers];
+  std::unordered_set<int64_t> dead[kDirtyConsumers];
 
   void mark_dirty(int64_t key) {
-    if (!track_dirty) return;
-    dirty.insert(key);
-    dead.erase(key);
+    for (int c = 0; c < kDirtyConsumers; ++c) {
+      if (!track_dirty[c]) continue;
+      dirty[c].insert(key);
+      dead[c].erase(key);
+    }
   }
 
   void mark_dead(int64_t key) {
-    if (!track_dirty) return;
-    dirty.erase(key);
-    dead.insert(key);
+    for (int c = 0; c < kDirtyConsumers; ++c) {
+      if (!track_dirty[c]) continue;
+      dirty[c].erase(key);
+      dead[c].insert(key);
+    }
   }
 
   explicit Table(int d, size_t capacity) : dim(d) {
@@ -415,6 +427,23 @@ struct Table {
   }
 };
 
+// Stable chunked-export cursor: a snapshot of the KEY COLUMN taken
+// under the table lock at creation.  Iterating by key (8 B/row, the
+// same O(rows) footprint class as kv_export_freq) instead of by slab
+// position is what keeps the cursor valid across spill residence
+// moves, promotions, slab swap-removes and hash growth between chunk
+// calls — the value/freq window handed back per chunk is the only
+// O(window * dim) allocation the caller ever holds.  Keys that
+// vanish between snapshot and read (evicted, deleted) are skipped;
+// rows inserted after the snapshot are not part of this export (the
+// snapshot IS the export's consistency point for membership; row
+// CONTENT is read at chunk time, matching kv_export's semantics of
+// reading live state under the lock).
+struct ExportCursor {
+  std::vector<int64_t> keys;
+  size_t pos = 0;
+};
+
 }  // namespace
 
 extern "C" {
@@ -506,11 +535,27 @@ void kv_clear(void* handle) {
     t->spill->free_slots.clear();
     t->spill->next_slot = 0;
   }
-  // a replace-import starts a fresh delta baseline: whatever is
-  // imported next marks itself dirty, and tombstones for the old
-  // contents would be wrong (the importer owns the new truth)
-  t->dirty.clear();
-  t->dead.clear();
+  // a replace-import starts a fresh delta baseline FOR EVERY
+  // consumer: whatever is imported next marks itself dirty, and
+  // tombstones for the old contents would be wrong (the importer
+  // owns the new truth)
+  for (int c = 0; c < Table::kDirtyConsumers; ++c) {
+    t->dirty[c].clear();
+    t->dead[c].clear();
+  }
+}
+
+// Pre-size the hash table (and slab vectors) for ~n total rows so a
+// chunked import does not pay repeated O(rows) rehash/realloc storms
+// mid-stream.  Never shrinks.
+void kv_reserve(void* handle, long n) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  size_t want = t->used + static_cast<size_t>(n > 0 ? n : 0);
+  while ((want + 1) * 2 > t->keys.size()) t->grow();
+  t->row_keys.reserve(want);
+  t->freq.reserve(want);
+  t->values.reserve(want * t->dim);
 }
 
 // Chaos/test hook: make the spill tier's backing device fail like a
@@ -538,31 +583,51 @@ void kv_spill_break(void* handle) {
 // reference: tfplus checkpoint_manager.py:72 delta checkpoints).
 // ---------------------------------------------------------------------
 
-// Arm dirty/dead tracking on this table.  Mutations BEFORE arming
-// are not tracked — the caller baselines with a full snapshot (the
-// publisher's first publish is always a base).
-void kv_dirty_enable(void* handle) {
+static int clamp_consumer(int consumer) {
+  return (consumer < 0 || consumer >= Table::kDirtyConsumers)
+             ? 0 : consumer;
+}
+
+// Arm dirty/dead tracking for one consumer slot.  Mutations BEFORE
+// arming are not tracked — the caller baselines with a full snapshot
+// (the publisher's first publish / the delta checkpointer's first
+// export is always a base).
+void kv_dirty_enable_c(void* handle, int consumer) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
-  t->track_dirty = true;
+  t->track_dirty[clamp_consumer(consumer)] = true;
+}
+
+void kv_dirty_enable(void* handle) { kv_dirty_enable_c(handle, 0); }
+
+int kv_dirty_enabled_c(void* handle, int consumer) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  return t->track_dirty[clamp_consumer(consumer)] ? 1 : 0;
 }
 
 int kv_dirty_enabled(void* handle) {
+  return kv_dirty_enabled_c(handle, 0);
+}
+
+long kv_dirty_count_c(void* handle, int consumer) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
-  return t->track_dirty ? 1 : 0;
+  return static_cast<long>(t->dirty[clamp_consumer(consumer)].size());
 }
 
 long kv_dirty_count(void* handle) {
+  return kv_dirty_count_c(handle, 0);
+}
+
+long kv_dead_count_c(void* handle, int consumer) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
-  return static_cast<long>(t->dirty.size());
+  return static_cast<long>(t->dead[clamp_consumer(consumer)].size());
 }
 
 long kv_dead_count(void* handle) {
-  Table* t = static_cast<Table*>(handle);
-  std::lock_guard<std::mutex> lock(t->mu);
-  return static_cast<long>(t->dead.size());
+  return kv_dead_count_c(handle, 0);
 }
 
 // Export only the rows touched since the last clear — O(rows
@@ -571,16 +636,17 @@ long kv_dead_count(void* handle) {
 // dirty set under the same lock hold, so a mutation racing the
 // export stays dirty for the next delta instead of vanishing.
 // Returns rows written (≤ max_n; loop when dirty_count > max_n).
-long kv_export_dirty(void* handle, int64_t* keys_out,
-                     float* values_out, uint64_t* freq_out,
-                     long max_n, int clear) {
+long kv_export_dirty_c(void* handle, int64_t* keys_out,
+                       float* values_out, uint64_t* freq_out,
+                       long max_n, int clear, int consumer) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
+  auto& dirty = t->dirty[clamp_consumer(consumer)];
   long n = 0;
   std::vector<int64_t> exported;
-  exported.reserve(std::min<size_t>(t->dirty.size(),
+  exported.reserve(std::min<size_t>(dirty.size(),
                                     static_cast<size_t>(max_n)));
-  for (int64_t key : t->dirty) {
+  for (int64_t key : dirty) {
     if (n >= max_n) break;
     uint64_t fq = 0;
     if (!t->read_row(key, values_out + n * t->dim, &fq)) {
@@ -596,36 +662,52 @@ long kv_export_dirty(void* handle, int64_t* keys_out,
     ++n;
   }
   if (clear) {
-    for (int64_t key : exported) t->dirty.erase(key);
+    for (int64_t key : exported) dirty.erase(key);
   }
   return n;
 }
 
+long kv_export_dirty(void* handle, int64_t* keys_out,
+                     float* values_out, uint64_t* freq_out,
+                     long max_n, int clear) {
+  return kv_export_dirty_c(handle, keys_out, values_out, freq_out,
+                           max_n, clear, 0);
+}
+
 // Deletion tombstones accumulated since the last clear (evictions a
 // delta consumer must replay).
-long kv_export_dead(void* handle, int64_t* keys_out, long max_n,
-                    int clear) {
+long kv_export_dead_c(void* handle, int64_t* keys_out, long max_n,
+                      int clear, int consumer) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
+  auto& dead = t->dead[clamp_consumer(consumer)];
   long n = 0;
   std::vector<int64_t> exported;
-  for (int64_t key : t->dead) {
+  for (int64_t key : dead) {
     if (n >= max_n) break;
     keys_out[n++] = key;
     exported.push_back(key);
   }
   if (clear) {
-    for (int64_t key : exported) t->dead.erase(key);
+    for (int64_t key : exported) dead.erase(key);
   }
   return n;
 }
 
-void kv_clear_dirty(void* handle) {
+long kv_export_dead(void* handle, int64_t* keys_out, long max_n,
+                    int clear) {
+  return kv_export_dead_c(handle, keys_out, max_n, clear, 0);
+}
+
+void kv_clear_dirty_c(void* handle, int consumer) {
   Table* t = static_cast<Table*>(handle);
   std::lock_guard<std::mutex> lock(t->mu);
-  t->dirty.clear();
-  t->dead.clear();
+  int c = clamp_consumer(consumer);
+  t->dirty[c].clear();
+  t->dead[c].clear();
 }
+
+void kv_clear_dirty(void* handle) { kv_clear_dirty_c(handle, 0); }
 
 // Remove specific keys from either tier (delta-apply of eviction
 // tombstones on a serving replica; O(1) amortized per key).  The
@@ -750,6 +832,61 @@ long kv_export_freq(void* handle, uint64_t* freq_out, long max_n) {
       if (n >= max_n) break;
       if (t->spill_read(kv.second, nullptr, freq_out + n)) ++n;
     }
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------
+// Cursor-based chunked export: O(window) value memory per call.
+// ---------------------------------------------------------------------
+
+// Snapshot the key column (both tiers) under the lock; the returned
+// cursor iterates it in kv_export_chunk calls.  Valid across spill
+// residence moves, promotions and slab compactions between chunks —
+// membership is fixed at snapshot time, content is read live.  The
+// caller MUST free it with kv_export_cursor_free.
+void* kv_export_cursor_new(void* handle) {
+  Table* t = static_cast<Table*>(handle);
+  std::lock_guard<std::mutex> lock(t->mu);
+  auto* c = new ExportCursor();
+  c->keys.reserve(
+      t->row_keys.size() + (t->spill ? t->spill->index.size() : 0));
+  c->keys.insert(c->keys.end(), t->row_keys.begin(),
+                 t->row_keys.end());
+  if (t->spill) {
+    for (const auto& kv : t->spill->index) c->keys.push_back(kv.first);
+  }
+  return c;
+}
+
+long kv_export_cursor_remaining(void* cursor) {
+  auto* c = static_cast<ExportCursor*>(cursor);
+  return static_cast<long>(c->keys.size() - c->pos);
+}
+
+void kv_export_cursor_free(void* cursor) {
+  delete static_cast<ExportCursor*>(cursor);
+}
+
+// Export up to max_n rows at the cursor: DRAM rows memcpy'd, spilled
+// rows read IN PLACE (no promotion, no residence churn).  Keys that
+// vanished since the snapshot (evicted/deleted) are skipped inside
+// the same lock hold, so a return of 0 means the cursor is
+// exhausted, never "this window happened to be all tombstones".
+long kv_export_chunk(void* handle, void* cursor, int64_t* keys_out,
+                     float* values_out, uint64_t* freq_out,
+                     long max_n) {
+  Table* t = static_cast<Table*>(handle);
+  auto* c = static_cast<ExportCursor*>(cursor);
+  std::lock_guard<std::mutex> lock(t->mu);
+  long n = 0;
+  while (n < max_n && c->pos < c->keys.size()) {
+    int64_t key = c->keys[c->pos++];
+    uint64_t fq = 0;
+    if (!t->read_row(key, values_out + n * t->dim, &fq)) continue;
+    keys_out[n] = key;
+    freq_out[n] = fq;
+    ++n;
   }
   return n;
 }

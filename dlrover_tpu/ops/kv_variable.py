@@ -37,6 +37,13 @@ _SPILL_DISK_ROWS_GAUGE = _REG.gauge(
 
 _lib = None
 
+# Dirty-baseline consumer slots: the serving publisher and the delta
+# flash checkpointer drain deltas on independent cadences — each owns
+# its own dirty/dead baseline on the C++ table so neither plane can
+# clear rows out of the other's next delta.
+DIRTY_CONSUMER_SERVING = 0
+DIRTY_CONSUMER_CHECKPOINT = 1
+
 
 def _load():
     global _lib
@@ -105,24 +112,46 @@ def _load():
             ctypes.c_float, ctypes.c_float,
         ]
         lib.kv_clear.argtypes = [ctypes.c_void_p]
+        lib.kv_reserve.argtypes = [ctypes.c_void_p, ctypes.c_long]
         lib.kv_spill_break.argtypes = [ctypes.c_void_p]
-        lib.kv_dirty_enable.argtypes = [ctypes.c_void_p]
-        lib.kv_dirty_enabled.restype = ctypes.c_int
-        lib.kv_dirty_enabled.argtypes = [ctypes.c_void_p]
-        lib.kv_dirty_count.restype = ctypes.c_long
-        lib.kv_dirty_count.argtypes = [ctypes.c_void_p]
-        lib.kv_dead_count.restype = ctypes.c_long
-        lib.kv_dead_count.argtypes = [ctypes.c_void_p]
-        lib.kv_export_dirty.restype = ctypes.c_long
-        lib.kv_export_dirty.argtypes = [
+        lib.kv_dirty_enable_c.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.kv_dirty_enabled_c.restype = ctypes.c_int
+        lib.kv_dirty_enabled_c.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.kv_dirty_count_c.restype = ctypes.c_long
+        lib.kv_dirty_count_c.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.kv_dead_count_c.restype = ctypes.c_long
+        lib.kv_dead_count_c.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.kv_export_dirty_c.restype = ctypes.c_long
+        lib.kv_export_dirty_c.argtypes = [
             ctypes.c_void_p, i64p, f32p, u64p, ctypes.c_long,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.kv_export_dead_c.restype = ctypes.c_long
+        lib.kv_export_dead_c.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_long, ctypes.c_int,
             ctypes.c_int,
         ]
-        lib.kv_export_dead.restype = ctypes.c_long
-        lib.kv_export_dead.argtypes = [
-            ctypes.c_void_p, i64p, ctypes.c_long, ctypes.c_int,
+        lib.kv_clear_dirty_c.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
         ]
-        lib.kv_clear_dirty.argtypes = [ctypes.c_void_p]
+        lib.kv_export_cursor_new.restype = ctypes.c_void_p
+        lib.kv_export_cursor_new.argtypes = [ctypes.c_void_p]
+        lib.kv_export_cursor_remaining.restype = ctypes.c_long
+        lib.kv_export_cursor_remaining.argtypes = [ctypes.c_void_p]
+        lib.kv_export_cursor_free.argtypes = [ctypes.c_void_p]
+        lib.kv_export_chunk.restype = ctypes.c_long
+        lib.kv_export_chunk.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64p, f32p, u64p,
+            ctypes.c_long,
+        ]
         lib.kv_delete.restype = ctypes.c_long
         lib.kv_delete.argtypes = [ctypes.c_void_p, i64p, ctypes.c_long]
         lib.kv_apply_sparse_sgd.argtypes = [
@@ -329,56 +358,142 @@ class KvVariable:
         )
         return keys[:got], values[:got], freq[:got]
 
-    # -- dirty-row delta surface (serving-plane incremental export) ---------
+    # -- chunked bulk transfer (O(window) value memory) ---------------------
 
-    def enable_dirty_tracking(self) -> None:
-        """Arm dirty/dead tracking (the serving publisher does this
-        at construction).  OPT-IN: untracked jobs pay nothing on the
-        optimizer hot path and accumulate no set overhead.  Mutations
-        before arming are not tracked — baseline with a full
-        snapshot (the publisher's first publish is always a base)."""
-        self._lib.kv_dirty_enable(self._handle)
+    def export_chunks(self, max_rows: int):
+        """Generator of ``(keys, values, freq)`` windows covering the
+        whole logical table (both tiers) without ever materializing
+        more than ``max_rows`` value rows at once — the bulk-export
+        primitive of streaming reshard and chunked checkpoint paths.
 
-    def dirty_tracking_enabled(self) -> bool:
-        return bool(self._lib.kv_dirty_enabled(self._handle))
+        The native cursor snapshots only the KEY column at the first
+        call (8 B/row — the same O(rows) footprint class as
+        :meth:`export_freq`) and stays valid across spill residence
+        moves between chunks; spilled rows are read in place, keys
+        evicted after the snapshot are skipped.  Each yielded window
+        is a fresh private array set — callers may hold or mutate it
+        freely."""
+        max_rows = max(1, int(max_rows))
+        cursor = ctypes.c_void_p(
+            self._lib.kv_export_cursor_new(self._handle)
+        )
+        try:
+            while True:
+                keys = np.empty(max_rows, dtype=np.int64)
+                values = np.empty(
+                    (max_rows, self.dim), dtype=np.float32
+                )
+                freq = np.empty(max_rows, dtype=np.uint64)
+                got = int(self._lib.kv_export_chunk(
+                    self._handle, cursor, _i64(keys), _f32(values),
+                    _u64(freq), max_rows,
+                ))
+                if got <= 0:
+                    break
+                yield keys[:got], values[:got], freq[:got]
+                if got < max_rows and not int(
+                    self._lib.kv_export_cursor_remaining(cursor)
+                ):
+                    break
+        finally:
+            self._lib.kv_export_cursor_free(cursor)
 
-    def dirty_count(self) -> int:
-        """Rows touched (value or frequency) since the last cleared
-        delta export — the next delta's size, and the bound on its
-        export stall (O(rows touched), never O(table))."""
-        return int(self._lib.kv_dirty_count(self._handle))
+    def import_chunked(
+        self, keys, values, freq=None, max_rows: int = 65536,
+    ) -> int:
+        """Windowed :meth:`import_`: slices of at most ``max_rows``
+        rows go through the native import one window at a time, so a
+        caller streaming from mmap-backed views never forces the
+        whole blob contiguous in RAM at once (each window is the only
+        private copy).  The spill pass runs per window with the usual
+        10% hysteresis, so DRAM stays bounded DURING the import, not
+        just after it.  Returns rows imported."""
+        keys = np.asarray(keys)
+        n = int(keys.shape[0])
+        max_rows = max(1, int(max_rows))
+        for lo in range(0, n, max_rows):
+            hi = min(n, lo + max_rows)
+            self.import_(
+                keys[lo:hi],
+                np.asarray(values)[lo:hi],
+                None if freq is None else np.asarray(freq)[lo:hi],
+            )
+        return n
 
-    def dead_count(self) -> int:
-        """Deletion tombstones (evicted keys) accumulated since the
-        last cleared delta export."""
-        return int(self._lib.kv_dead_count(self._handle))
+    def reserve(self, n: int) -> None:
+        """Pre-size the hash table and slab for ~``n`` more rows so a
+        chunked import pays no mid-stream rehash storms."""
+        self._lib.kv_reserve(self._handle, int(n))
+
+    # -- dirty-row delta surface (per-consumer incremental export) ----------
+
+    def enable_dirty_tracking(
+        self, consumer: int = DIRTY_CONSUMER_SERVING
+    ) -> None:
+        """Arm dirty/dead tracking for one consumer slot (the serving
+        publisher arms :data:`DIRTY_CONSUMER_SERVING`, the delta
+        flash checkpointer :data:`DIRTY_CONSUMER_CHECKPOINT` — the
+        two planes baseline independently).  OPT-IN: untracked jobs
+        pay nothing on the optimizer hot path and accumulate no set
+        overhead.  Mutations before arming are not tracked — baseline
+        with a full snapshot (the first publish/export is always a
+        base)."""
+        self._lib.kv_dirty_enable_c(self._handle, int(consumer))
+
+    def dirty_tracking_enabled(
+        self, consumer: int = DIRTY_CONSUMER_SERVING
+    ) -> bool:
+        return bool(
+            self._lib.kv_dirty_enabled_c(self._handle, int(consumer))
+        )
+
+    def dirty_count(
+        self, consumer: int = DIRTY_CONSUMER_SERVING
+    ) -> int:
+        """Rows touched (value or frequency) since this consumer's
+        last cleared delta export — the next delta's size, and the
+        bound on its export stall (O(rows touched), never
+        O(table))."""
+        return int(
+            self._lib.kv_dirty_count_c(self._handle, int(consumer))
+        )
+
+    def dead_count(
+        self, consumer: int = DIRTY_CONSUMER_SERVING
+    ) -> int:
+        """Deletion tombstones (evicted keys) accumulated since this
+        consumer's last cleared delta export."""
+        return int(
+            self._lib.kv_dead_count_c(self._handle, int(consumer))
+        )
 
     def export_dirty(
-        self, clear: bool = False
+        self, clear: bool = False,
+        consumer: int = DIRTY_CONSUMER_SERVING,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Export only the rows touched since the last cleared delta
-        (spill-tier rows read in place, no promotion).  With
-        ``clear``, exactly the exported keys leave the dirty set
+        """Export only the rows touched since this consumer's last
+        cleared delta (spill-tier rows read in place, no promotion).
+        With ``clear``, exactly the exported keys leave the dirty set
         atomically with the export — a concurrent mutation stays
         dirty for the NEXT delta instead of silently vanishing."""
         chunks = []
         while True:
-            n = self.dirty_count()
+            n = self.dirty_count(consumer)
             if n == 0:
                 break
             keys = np.empty(n, dtype=np.int64)
             values = np.empty((n, self.dim), dtype=np.float32)
             freq = np.empty(n, dtype=np.uint64)
-            got = self._lib.kv_export_dirty(
+            got = self._lib.kv_export_dirty_c(
                 self._handle, _i64(keys), _f32(values), _u64(freq),
-                n, int(clear),
+                n, int(clear), int(consumer),
             )
             chunks.append((keys[:got], values[:got], freq[:got]))
             # without clear, one pass covers the snapshot; with
             # clear, loop until the set drains (mutations racing the
             # export can top it back up — they belong to this delta
             # only if we catch them, the next one otherwise)
-            if not clear or self.dirty_count() == 0:
+            if not clear or self.dirty_count(consumer) == 0:
                 break
         if not chunks:
             return (
@@ -394,19 +509,23 @@ class KvVariable:
             np.concatenate([c[2] for c in chunks]),
         )
 
-    def export_dead(self, clear: bool = False) -> np.ndarray:
+    def export_dead(
+        self, clear: bool = False,
+        consumer: int = DIRTY_CONSUMER_SERVING,
+    ) -> np.ndarray:
         """The delta's deletion tombstones."""
-        n = self.dead_count()
+        n = self.dead_count(consumer)
         keys = np.empty(n, dtype=np.int64)
-        got = self._lib.kv_export_dead(
-            self._handle, _i64(keys), n, int(clear)
+        got = self._lib.kv_export_dead_c(
+            self._handle, _i64(keys), n, int(clear), int(consumer)
         )
         return keys[:got]
 
-    def clear_dirty(self):
-        """Reset both delta sets (a full-snapshot export baselines
-        the next delta)."""
-        self._lib.kv_clear_dirty(self._handle)
+    def clear_dirty(self, consumer: int = DIRTY_CONSUMER_SERVING):
+        """Reset this consumer's delta sets (a full-snapshot export
+        baselines its next delta).  Other consumers' baselines are
+        untouched — the two planes never clear each other."""
+        self._lib.kv_clear_dirty_c(self._handle, int(consumer))
 
     def delete(self, keys) -> int:
         """Remove specific keys from either tier (delta tombstone

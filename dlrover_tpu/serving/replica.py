@@ -32,12 +32,17 @@ import os
 import io
 import threading
 import time
+import zipfile
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from dlrover_tpu import chaos as _chaos
-from dlrover_tpu.checkpoint.sparse import keys_digest, rows_digest
+from dlrover_tpu.checkpoint.sparse import (
+    keys_digest,
+    reshard_window_rows,
+    rows_digest,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.storage import get_checkpoint_storage
 from dlrover_tpu.ops.kv_variable import KvVariable
@@ -76,6 +81,59 @@ class TornGenerationError(RuntimeError):
     """A generation's blobs do not match its manifest digests."""
 
 
+class _NpyStream:
+    """Row-windowed reader of one ``.npy`` member inside an open
+    npz zip: parses the header, then serves ``read_rows(n)`` slices
+    straight off the (decompressing) member stream — the whole array
+    is never materialized.  Raises :class:`TornGenerationError` on
+    any malformed header/stream (the shapes torn replication
+    takes)."""
+
+    def __init__(self, zf: zipfile.ZipFile, name: str):
+        from numpy.lib import format as npformat
+
+        try:
+            self._fh = zf.open(name + ".npy")
+            version = npformat.read_magic(self._fh)
+            shape, fortran, dtype = npformat._read_array_header(
+                self._fh, version
+            )
+        except Exception as e:  # noqa: BLE001 - torn/malformed member
+            raise TornGenerationError(
+                f"blob member {name!r} unreadable ({e})"
+            )
+        if fortran:
+            raise TornGenerationError(
+                f"blob member {name!r} is fortran-ordered"
+            )
+        self.shape = tuple(int(d) for d in shape)
+        self.rows = self.shape[0] if self.shape else 0
+        self.dtype = dtype
+        self._row_elems = (
+            int(np.prod(self.shape[1:], dtype=np.int64))
+            if len(self.shape) > 1 else 1
+        )
+        self._row_bytes = self._row_elems * dtype.itemsize
+
+    def read_rows(self, n: int) -> np.ndarray:
+        want = n * self._row_bytes
+        buf = self._fh.read(want)
+        if len(buf) != want:
+            raise TornGenerationError(
+                "blob member truncated mid-stream"
+            )
+        arr = np.frombuffer(buf, dtype=self.dtype)
+        if len(self.shape) > 1:
+            arr = arr.reshape((n,) + self.shape[1:])
+        return arr
+
+    def close(self):
+        try:
+            self._fh.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 class ServingReplica:
     """In-process replica over a serving directory.
 
@@ -111,13 +169,16 @@ class ServingReplica:
 
     # -- ingest -------------------------------------------------------------
 
-    def _load_generation(self, gen: int):
+    def _load_generation(self, gen: int, manifest=None):
         """Read + digest-verify one committed generation; returns
         (manifest, {table: blob dict}).  Raises on a torn read —
-        the caller leaves the tables at the previous generation."""
-        manifest = read_manifest(
-            self.serving_dir, gen, self.storage
-        )
+        the caller leaves the tables at the previous generation.
+        ``manifest`` skips the re-read when the caller already holds
+        it (the ingest loop reads it to branch on kind)."""
+        if manifest is None:
+            manifest = read_manifest(
+                self.serving_dir, gen, self.storage
+            )
         if manifest is None:
             raise TornGenerationError(
                 f"generation {gen}: manifest missing/unreadable"
@@ -214,6 +275,117 @@ class ServingReplica:
             self.generation_step = manifest.get("step")
         return digests
 
+    def _open_blobs(self, gen: int):
+        """File-like over a generation's blobs.npz: a plain file
+        handle on posix (no bytes materialized), a BytesIO over the
+        raw bytes for remote backends (still avoids the decoded
+        second copy)."""
+        path = os.path.join(
+            self.serving_dir, gen_dirname(gen), BLOBS
+        )
+        if os.path.exists(path):
+            return open(path, "rb")
+        raw = self.storage.read(path)
+        if raw is None:
+            raise TornGenerationError(
+                f"generation {gen}: blobs missing"
+            )
+        return io.BytesIO(bytes(raw))
+
+    def _ingest_base_windowed(
+        self, gen: int, manifest, window_rows: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Base ingest with bounded memory: each table streams off
+        the npz in row windows into a fresh STAGING table (nothing
+        the lookup path can see), with the additive per-window digest
+        checked against the manifest; the swap lock is then held only
+        for the O(1) table swap — a multi-GB base neither spikes
+        replica RSS by its decoded size nor stalls lookups for its
+        apply.  Any mismatch/truncation raises with the served
+        tables untouched (the staging tables are simply dropped)."""
+        staging: Dict[str, KvVariable] = {}
+        digests: Dict[str, Dict[str, Any]] = {}
+        fh = self._open_blobs(gen)
+        try:
+            try:
+                zf = zipfile.ZipFile(fh)
+            except Exception as e:  # noqa: BLE001 - torn archive
+                raise TornGenerationError(
+                    f"generation {gen}: blobs unreadable ({e})"
+                )
+            with zf:
+                for name, meta in manifest.get("tables", {}).items():
+                    dim = int(meta.get("dim") or 0)
+                    table = KvVariable(dim, name=name)
+                    table.reserve(int(meta.get("rows", 0)))
+                    win = window_rows or reshard_window_rows(
+                        dim * 4 + 16
+                    )
+                    ks = _NpyStream(zf, f"{name}::keys")
+                    vs = _NpyStream(zf, f"{name}::values")
+                    fs = _NpyStream(zf, f"{name}::freq")
+                    if not (ks.rows == vs.rows == fs.rows):
+                        raise TornGenerationError(
+                            f"generation {gen}: table {name!r} "
+                            "member row counts disagree"
+                        )
+                    dig = 0
+                    done = 0
+                    try:
+                        while done < ks.rows:
+                            n = min(win, ks.rows - done)
+                            kwin = ks.read_rows(n)
+                            vwin = vs.read_rows(n)
+                            fwin = fs.read_rows(n)
+                            table.import_(kwin, vwin, fwin)
+                            if self.verify_digests:
+                                dig = (
+                                    dig + rows_digest(
+                                        kwin, vwin, fwin
+                                    )
+                                ) % (1 << 64)
+                            done += n
+                    finally:
+                        ks.close()
+                        vs.close()
+                        fs.close()
+                    dead_s = _NpyStream(zf, f"{name}::dead")
+                    try:
+                        dead = dead_s.read_rows(dead_s.rows)
+                    finally:
+                        dead_s.close()
+                    if self.verify_digests:
+                        got = f"{dig:016x}"
+                        got_dead = f"{keys_digest(dead):016x}"
+                        if got != meta.get("digest") or (
+                            got_dead != meta.get("dead_digest")
+                        ):
+                            raise TornGenerationError(
+                                f"generation {gen}: table {name!r} "
+                                f"digest mismatch (manifest "
+                                f"{meta.get('digest')} dead "
+                                f"{meta.get('dead_digest')}, read "
+                                f"{got} dead {got_dead})"
+                            )
+                    staging[name] = table
+                    digests[name] = {
+                        "rows": int(done),
+                        "sum": meta.get("digest"),
+                        "dead": int(dead.size),
+                        "dead_sum": meta.get("dead_digest"),
+                    }
+        finally:
+            fh.close()
+        with self._swap_lock:
+            # same chaos semantics as the delta apply: a kill here is
+            # the replica dying mid-ingest, tables swap-or-nothing
+            _chaos.fire("serving.ingest", step=gen)
+            for name, table in staging.items():
+                self.tables[name] = table
+            self.generation = gen
+            self.generation_step = manifest.get("step")
+        return digests
+
     def ingest_pending(self) -> List[int]:
         """Catch up to the tracker: ingest every committed generation
         above the currently served one (re-basing when behind the
@@ -234,13 +406,33 @@ class ServingReplica:
         for gen in chain:
             t0 = time.perf_counter()
             try:
-                manifest, per_table = self._load_generation(gen)
+                manifest = read_manifest(
+                    self.serving_dir, gen, self.storage
+                )
+                if manifest is None:
+                    raise TornGenerationError(
+                        f"generation {gen}: manifest "
+                        "missing/unreadable"
+                    )
+                if manifest.get("kind", "base") == "base":
+                    # bases stream windowed into staging tables —
+                    # the swap lock is held O(1), replica RSS never
+                    # spikes by the decoded base size
+                    digests = self._ingest_base_windowed(
+                        gen, manifest
+                    )
+                else:
+                    manifest, per_table = self._load_generation(
+                        gen, manifest
+                    )
+                    digests = self._apply_generation(
+                        manifest, per_table
+                    )
             except TornGenerationError as e:
                 # stop at the first unreadable link: the previous
                 # generation keeps serving; the next poll retries
                 logger.warning("serving ingest stopped: %s", e)
                 break
-            digests = self._apply_generation(manifest, per_table)
             seconds = time.perf_counter() - t0
             kind = manifest.get("kind", "base")
             freshness = max(

@@ -122,7 +122,23 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
             # marks an export of only the rows touched since the
             # last cleared delta (dead_rows = eviction tombstones,
             # table_rows = logical table size for the delta ratio)
-            "delta", "dead_rows", "table_rows"]),
+            "delta", "dead_rows", "table_rows",
+            # streaming reshard (bounded-memory cross-world
+            # restore): streamed=True, chunks = windows applied,
+            # window_rows = the configured window
+            "streamed", "chunks", "window_rows",
+            # delta flash checkpoints (hot save path): kind =
+            # base/delta for the CHECKPOINT consumer, with the
+            # chain link steps a restore replays
+            "kind", "consumer", "base_step", "parent_step",
+            "chain_len"]),
+        # one window of a streaming reshard applied: rows = input
+        # rows partitioned in this window, owned = the subset this
+        # rank imported; the mid-reshard kill scenario counts these
+        # to prove the replayed reshard re-ran from the top
+        _s("kv_reshard_chunk",
+           ["table", "chunk", "rows", "owned", "rank"],
+           ["step"]),
         # -- serving plane (train-to-serve publication) --------------
         # one committed generation published by the trainer: kind =
         # base (full snapshot) or delta (dirty rows + tombstones);

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.common import env_utils, jax_compat
@@ -140,12 +141,48 @@ class TrainState:
     step: jax.Array
 
     @classmethod
-    def create(cls, params, optimizer):
+    def create(cls, params, optimizer, opt_state=None, step=None):
+        """``opt_state``/``step`` default to a fresh optimizer init —
+        pass restored slots to DEFER the eager init entirely (a
+        restore that already supplies the moments must not pay
+        ``optimizer.init`` just to overwrite it)."""
         return cls(
             params=params,
-            opt_state=optimizer.init(params),
-            step=jnp.zeros((), dtype=jnp.int32),
+            opt_state=(
+                optimizer.init(params) if opt_state is None
+                else opt_state
+            ),
+            step=(
+                jnp.zeros((), dtype=jnp.int32) if step is None
+                else step
+            ),
         )
+
+
+def restore_train_state(optimizer, restored) -> TrainState:
+    """Typed :class:`TrainState` from a restored nested dict with the
+    recovery ``state_build`` residual shaved off: the optimizer is
+    never re-initialized (the restore supplies params AND slots) and
+    every leaf conversion rides ONE batched ``device_put`` instead of
+    a per-leaf ``jnp.asarray`` chain (each of which dispatches its
+    own transfer — ~0.3 s of the measured recovery budget at toy
+    scale, worse at real scale).
+
+    The typed optax containers are rebuilt by tracing
+    ``TrainState.create`` over the restored params' avals — no model
+    code runs and nothing touches a device during the trace."""
+    from dlrover_tpu.checkpoint.checkpointer import (
+        restore_to_template,
+    )
+
+    abs_params = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        restored["params"],
+    )
+    template = jax.eval_shape(
+        lambda p: TrainState.create(p, optimizer), abs_params
+    )
+    return restore_to_template(template, restored)
 
 
 def make_train_step(

@@ -59,7 +59,7 @@ def pytest_collection_modifyitems(config, items):
         "test_chaos.py",
         "test_restore_pipeline.py", "test_master_journal.py",
         "test_resize.py", "test_sparse_checkpoint.py",
-        "test_serving.py",
+        "test_serving.py", "test_streaming_sparse.py",
         "test_recovery.py", "test_aot_cache.py",
         "test_slo.py", "test_fleet.py",
         # the chaos acceptance e2e runs (worker kill, shm fallback,

@@ -78,6 +78,18 @@ def _fat_snapshot() -> dict:
             "full_export_s": 0.345678,
             "delta_export_s": 0.008123,
         },
+        "sparse_scale": {
+            "table_rows": 150000,
+            "table_mb": 38.912345,
+            "spill_budget_mb": 9.712345,
+            "delta_ratio": 0.012345,
+            "export_stall_speedup": 690.612345,
+            "reshard_MBps": 1424.612345,
+            "reshard_chunks": 20,
+            "reshard_peak_extra_rss_mb": 7.212345,
+            "oneshot_peak_extra_rss_mb": 73.212345,
+            "rss_oneshot_over_streaming_x": 10.212345,
+        },
         "gqa_attention_kernel": {"seq2048": {"speedup": 1.812345}},
         "attention_kernel": {"seq8192": {"flash_vs_xla_speedup": 2.9}},
         "elastic_recovery": {
@@ -100,7 +112,7 @@ def _fat_snapshot() -> dict:
         "goodput", "llama_train_step", "train_step", "xl_train_step",
         "xl_act_offload", "flash_ckpt", "auto_config", "sparse_kv",
         "input_pipeline", "gqa_attention_kernel", "attention_kernel",
-        "elastic_recovery", "serving", "multislice",
+        "elastic_recovery", "serving", "sparse_scale", "multislice",
         "sequence_parallel",
     ]
     for name in sections:

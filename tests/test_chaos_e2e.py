@@ -388,6 +388,46 @@ def test_sparse_spill_io_error_graceful(tmp_path):
     assert any(e.get("spill_disabled") for e in exports), exports
 
 
+def test_sparse_streaming_reshard_kill(tmp_path):
+    """ISSUE 14 acceptance (tier-1): SIGKILL a worker MID-STREAMING-
+    RESHARD.  The harness pre-seeds a committed world-2 sparse
+    checkpoint; the world-1 job's first restore streams the
+    cross-world reshard in bounded windows and dies on the 3rd
+    ``kv.reshard_chunk``.  Committed storage is untouched by the
+    partial reshard, so the replacement replays it from the same
+    shards: the digest sums on its resharded restore equal the
+    seeder's per-shard export sums with imported rows == the distinct
+    union — exactly-once, no chunk double-imported — and the job
+    still trains to completion."""
+    report = harness.run_scenario(
+        scenarios.sparse_streaming_reshard_kill(seed=79),
+        workdir=str(tmp_path / "run"),
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    # exactly one seeded kill, ON the reshard-chunk hook
+    assert len(report.timeline) == 1, report.timeline
+    _seq, point, _rule, action, _step = report.timeline[0]
+    assert point == "kv.reshard_chunk" and action == "kill"
+    # both incarnations streamed: the first emitted partial chunk
+    # events before dying, the second a full set + the restore event
+    chunk_events = [
+        e for e in report.events
+        if e.get("type") == "kv_reshard_chunk"
+    ]
+    assert chunk_events, "no kv_reshard_chunk events recorded"
+    restores = [
+        e for e in report.events
+        if e.get("type") == "kv_checkpoint"
+        and e.get("stage") == "restore" and e.get("resharded")
+    ]
+    assert restores and restores[-1].get("streamed"), restores
+    assert restores[-1].get("chunks", 0) > 1
+    # the incomplete first attempt emitted FEWER chunk events than
+    # the completed replay's chunk count (it died at chunk 3)
+    assert len(chunk_events) > restores[-1]["chunks"]
+
+
 @pytest.mark.slow
 def test_sparse_resize_churn(tmp_path):
     """ISSUE 9 acceptance (slow): the genuinely novel combination —
