@@ -40,6 +40,7 @@ from dlrover_tpu.chaos.scenarios import (
     CKPT_EVERY_ENV,
     DISK_EVERY_ENV,
     RESIZE_TRAIN_SCRIPT,
+    RL_TRAIN_SCRIPT,
     RUN_OPTIONS,
     SHARD_DATASET_ENV,
     SPARSE_RESHARD_TRAIN_SCRIPT,
@@ -49,6 +50,7 @@ from dlrover_tpu.chaos.scenarios import (
     STEP_SLEEP_ENV,
     TOTAL_STEPS_ENV,
     resize_reference_losses,
+    rl_reference_losses,
     sparse_reference_losses,
 )
 from dlrover_tpu.chaos.schedule import Scenario, load_scenario
@@ -73,6 +75,7 @@ TRAIN_SCRIPTS = {
     "sparse_resize": SPARSE_RESIZE_TRAIN_SCRIPT,
     "sparse_serving": SPARSE_SERVING_TRAIN_SCRIPT,
     "sparse_reshard": SPARSE_RESHARD_TRAIN_SCRIPT,
+    "rl": RL_TRAIN_SCRIPT,
 }
 
 
@@ -2199,6 +2202,23 @@ def invariants_for_scenario(
                 sparse_reference_losses(total_steps)
             ),
             KvStateRoundTrip(),
+        ]
+    if name == "rl-rollout-worker-kill":
+        # the elastic-RL acceptance trail: full recovery set + the
+        # PPO loss trajectory equal to the uninterrupted control
+        # (flash restore + deterministic train-step replay + the
+        # requeued lease regenerated bit-identically), exactly-once
+        # rollout-lease accounting from the master's journaled
+        # dispatch/ack trail, and the recovery outage booked to a
+        # real cause bucket (rendezvous/restore), not unattributed
+        return default_invariants(
+            total_steps, ckpt_every, workdir
+        ) + [
+            LossTrajectoryMatches(rl_reference_losses(total_steps)),
+            NoDuplicateShards(
+                dataset_size=total_steps, dataset="rl-rollouts"
+            ),
+            GoodputLossAttributed(min_attributed_frac=0.5),
         ]
     if name == "sparse-spill-io-error":
         # no loss-trajectory assertion: rows stranded on the dead

@@ -708,6 +708,312 @@ def sparse_reference_losses(total_steps: int):
     return out
 
 
+# Elastic PPO loop (ISSUE 16): the four-role RL engine driven by
+# master-dispatched ROLLOUT LEASES.  Each shard task is one rollout:
+# prompts and the generation RNG derive purely from the lease id, so
+# a lease requeued off a SIGKILLed worker regenerates bit-identically
+# on the replacement — exactly-once rollout accounting from
+# shard_dispatch/shard_ack events.  The full four-role state (actor +
+# critic train states, RNG key, iteration cursor, the PARTIAL rollout
+# buffer) rides every flash snapshot through PPOStateAdapter; the
+# snapshot is taken after every completed lease and NEVER after a
+# train phase, so a mid-iteration kill restores to the last completed
+# lease and REPLAYS that iteration's train steps — the replayed
+# train_step losses are the loss-trajectory invariant's
+# multi-incarnation cross-check.  One PPO train step per lease
+# (LEASES_PER_ITER leases buffered, then that many in-order PPO
+# updates), so total train steps == total leases == TOTAL_STEPS.
+# argv: ckpt_dir
+RL_TRAIN_SCRIPT = r'''
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu import chaos as _chaos
+from dlrover_tpu.accel import Strategy
+from dlrover_tpu.agent.sharding_client import ShardingClient
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.models.gpt import GPT, GPTConfig
+from dlrover_tpu.rl.elastic import (
+    PPOCursor, PPOStateAdapter, lease_prompts, lease_rng,
+    resolve_role_steps,
+)
+from dlrover_tpu.rl.model_engine import ModelRole, RLModelEngine, RoleSpec
+from dlrover_tpu.rl.rollout import (
+    make_actor_loss, make_critic_loss, make_experience,
+    sample_rollout_batch, train_on_batch,
+)
+from dlrover_tpu.rl.trainer import ReplayBuffer
+from dlrover_tpu.telemetry.events import emit_event
+from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+ckpt_dir = sys.argv[1]
+TOTAL_STEPS = int(os.environ.get("DLROVER_CHAOS_TOTAL_STEPS", "8"))
+STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
+LEASES_PER_ITER = int(
+    os.environ.get("DLROVER_CHAOS_RL_LEASES_PER_ITER", "2")
+)
+RESTART_COUNT = int(os.environ.get("DLROVER_RESTART_COUNT", "0") or 0)
+NODE_RANK = int(os.environ.get("DLROVER_NODE_RANK", "0") or 0)
+
+tracker = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+
+def committed_step():
+    try:
+        with open(tracker) as f:
+            return int(f.read().strip() or -1)
+    except (OSError, ValueError):
+        return -1
+
+# MUST mirror scenarios.rl_reference_losses exactly.  B=8 divides
+# the data-axis of any test mesh (1 or 8 host devices)
+B, PROMPT_LEN, MAX_NEW, VOCAB, SEED = 8, 4, 8, 32, 2
+actor_cfg = GPTConfig.tiny(max_seq_len=16, vocab_size=VOCAB)
+actor_model = GPT(actor_cfg)
+critic_model = GPT(
+    GPTConfig.tiny(max_seq_len=16, vocab_size=VOCAB, head="value")
+)
+ref_model = GPT(actor_cfg)
+ref_params = actor_model.init_params(jax.random.PRNGKey(1))
+sample = sample_rollout_batch(
+    jnp.zeros((B, PROMPT_LEN), jnp.int32), MAX_NEW
+)
+dp = Strategy(opts=[("parallel_mode", {})])
+engine = RLModelEngine(sample, {
+    ModelRole.ACTOR: RoleSpec(
+        model=actor_model,
+        loss_fn=make_actor_loss(actor_model, PROMPT_LEN),
+        optim_factory=lambda: optax.adam(5e-3),
+        strategy=dp,
+    ),
+    ModelRole.CRITIC: RoleSpec(
+        model=critic_model,
+        loss_fn=make_critic_loss(critic_model, PROMPT_LEN),
+        optim_factory=lambda: optax.adam(1e-3),
+        strategy=dp,
+    ),
+    ModelRole.REF: RoleSpec(model=ref_model, params=ref_params),
+}).build()
+
+def reward_fn(sequences):
+    resp = sequences[:, PROMPT_LEN:]
+    return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+# register the PPO adapter BEFORE the load: the import needs the
+# engine's fresh states as restore templates
+buffer = ReplayBuffer()
+cursor = PPOCursor(rng_key=np.asarray(jax.random.PRNGKey(SEED)))
+adapter = PPOStateAdapter(engine, buffer, cursor)
+ckpt = Checkpointer(ckpt_dir)
+ckpt.register_sparse(adapter)
+start_step, restored = ckpt.load_checkpoint()
+# roles/buffer/cursor were rebuilt by the adapter during the load;
+# the dense subtree only carried the trainer bookkeeping
+
+trainer = ElasticTrainer(global_batch_size=B, micro_batch_size=B,
+                         dp_size=1)
+trainer.global_step = cursor.ppo_updates
+
+# AOT-cached actor/critic steps: a respawn deserializes the compiled
+# steps the first incarnation wrote — retrace-free RL recovery
+steps = {
+    role: res.fn
+    for role, res in resolve_role_steps(engine, sample).items()
+}
+
+sc = ShardingClient(
+    dataset_name="rl-rollouts", batch_size=1, num_epochs=1,
+    dataset_size=TOTAL_STEPS, shuffle=False,
+    num_minibatches_per_shard=1, storage_type="table",
+)
+
+phase_s = {"rollout": 0.0, "score": 0.0, "gae": 0.0}
+
+def train_phase():
+    # in INSERTION order, never shuffled: a restored incarnation
+    # replays byte-identical PPO steps off the restored buffer
+    t0 = time.perf_counter()
+    batches = buffer.batches()
+    actor_loss = critic_loss = 0.0
+    for bt in batches:
+        with trainer.profile("compute"):
+            losses = train_on_batch(engine, bt, steps=steps)
+        actor_loss = losses["actor_loss"]
+        critic_loss = losses["critic_loss"]
+        trainer.report_step(
+            {"loss": losses["actor_loss"] + losses["critic_loss"]}
+        )
+    cursor.ppo_updates = trainer.global_step
+    emit_event(
+        "rl_iteration",
+        iteration=trainer.global_step // max(1, LEASES_PER_ITER),
+        restart_count=RESTART_COUNT, node_rank=NODE_RANK,
+        leases=len(batches),
+        rollout_s=round(phase_s["rollout"], 4),
+        score_s=round(phase_s["score"], 4),
+        gae_s=round(phase_s["gae"], 4),
+        train_s=round(time.perf_counter() - t0, 4),
+        actor_loss=actor_loss, critic_loss=critic_loss,
+    )
+    phase_s.update(rollout=0.0, score=0.0, gae=0.0)
+    buffer.reset()
+
+while True:
+    if len(buffer.batches()) >= LEASES_PER_ITER:
+        train_phase()
+    with trainer.profile("data_wait"):
+        task = sc.fetch_task()
+    if task is None:
+        break
+    lease_id = int(task.start)
+    if lease_id < cursor.leases_done:
+        # the checkpointed predecessor already buffered (or trained
+        # on) this lease before dying un-acked: ack WITHOUT
+        # regenerating, or the batch would enter the buffer twice
+        sc.report_task_done(task.task_id)
+        continue
+    with trainer.profile("rollout"):
+        batch, metrics = make_experience(
+            engine, jnp.asarray(
+                lease_prompts(lease_id, B, PROMPT_LEN, VOCAB)
+            ),
+            lease_rng(SEED, lease_id), max_new_tokens=MAX_NEW,
+            kl_coef=0.01, reward_fn=reward_fn,
+        )
+    for k in ("rollout", "score", "gae"):
+        phase_s[k] += metrics[k + "_s"]
+    # the kill rule lands HERE: batch generated but neither buffered,
+    # checkpointed nor acked — the master requeues the lease and the
+    # replacement regenerates it bit-identically
+    _chaos.fire("rl.rollout", step=lease_id)
+    buffer.add(batch)
+    cursor.leases_done = lease_id + 1
+    # flash snapshot after EVERY completed lease and never after a
+    # train phase: a mid-iteration kill restores to the last lease
+    # and REPLAYS the iteration's train steps (the loss-trajectory
+    # invariant's multi-incarnation cross-check needs those replays)
+    with trainer.profile("checkpoint"):
+        ckpt.save_checkpoint(
+            trainer.global_step, {"trainer": trainer.state_dict()},
+            storage_type=StorageType.MEMORY,
+        )
+    sc.report_task_done(task.task_id)
+    if STEP_SLEEP:
+        time.sleep(STEP_SLEEP)
+
+if buffer.batches():
+    train_phase()
+
+FINAL_STEP = trainer.global_step
+final_sd = {"trainer": trainer.state_dict()}
+deadline = time.time() + 60
+while time.time() < deadline and committed_step() < FINAL_STEP:
+    ckpt.save_checkpoint(
+        FINAL_STEP, final_sd, storage_type=StorageType.DISK,
+    )
+    ckpt.wait()
+    poll_end = time.time() + 10
+    while time.time() < poll_end and committed_step() < FINAL_STEP:
+        time.sleep(0.2)
+assert committed_step() >= FINAL_STEP, (
+    "checkpoint commit did not land"
+)
+ckpt.close()
+'''
+
+
+def rl_reference_losses(total_steps: int):
+    """Uninterrupted-control loss trajectory of
+    :data:`RL_TRAIN_SCRIPT`, computed in-process: same four-role
+    engine recipe, same lease-derived prompts/RNG, same
+    buffer-then-train iteration structure.  ``result[k-1]`` is the
+    combined actor+critic loss PPO train step ``k`` must report
+    regardless of kills and flash restores — a restore that dropped
+    an optimizer slot, a buffered rollout batch or the cursor forks
+    the trajectory at the first replayed step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.accel import Strategy
+    from dlrover_tpu.models.gpt import GPT, GPTConfig
+    from dlrover_tpu.rl.elastic import lease_prompts, lease_rng
+    from dlrover_tpu.rl.model_engine import (
+        ModelRole,
+        RLModelEngine,
+        RoleSpec,
+    )
+    from dlrover_tpu.rl.rollout import (
+        make_actor_loss,
+        make_critic_loss,
+        make_experience,
+        sample_rollout_batch,
+        train_on_batch,
+    )
+    from dlrover_tpu.rl.trainer import ReplayBuffer
+
+    b, prompt_len, max_new, vocab, seed = 8, 4, 8, 32, 2
+    leases_per_iter = 2
+    actor_cfg = GPTConfig.tiny(max_seq_len=16, vocab_size=vocab)
+    actor_model = GPT(actor_cfg)
+    critic_model = GPT(
+        GPTConfig.tiny(max_seq_len=16, vocab_size=vocab,
+                       head="value")
+    )
+    ref_model = GPT(actor_cfg)
+    ref_params = actor_model.init_params(jax.random.PRNGKey(1))
+    sample = sample_rollout_batch(
+        jnp.zeros((b, prompt_len), jnp.int32), max_new
+    )
+    dp = Strategy(opts=[("parallel_mode", {})])
+    engine = RLModelEngine(sample, {
+        ModelRole.ACTOR: RoleSpec(
+            model=actor_model,
+            loss_fn=make_actor_loss(actor_model, prompt_len),
+            optim_factory=lambda: optax.adam(5e-3),
+            strategy=dp,
+        ),
+        ModelRole.CRITIC: RoleSpec(
+            model=critic_model,
+            loss_fn=make_critic_loss(critic_model, prompt_len),
+            optim_factory=lambda: optax.adam(1e-3),
+            strategy=dp,
+        ),
+        ModelRole.REF: RoleSpec(model=ref_model, params=ref_params),
+    }).build()
+
+    def reward_fn(sequences):
+        resp = sequences[:, prompt_len:]
+        return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+    buffer = ReplayBuffer()
+    out = []
+    for lease_id in range(total_steps):
+        batch, _metrics = make_experience(
+            engine, jnp.asarray(
+                lease_prompts(lease_id, b, prompt_len, vocab)
+            ),
+            lease_rng(seed, lease_id), max_new_tokens=max_new,
+            kl_coef=0.01, reward_fn=reward_fn,
+        )
+        buffer.add(batch)
+        if len(buffer.batches()) >= leases_per_iter:
+            for bt in buffer.batches():
+                losses = train_on_batch(engine, bt)
+                out.append(
+                    losses["actor_loss"] + losses["critic_loss"]
+                )
+            buffer.reset()
+    for bt in buffer.batches():
+        losses = train_on_batch(engine, bt)
+        out.append(losses["actor_loss"] + losses["critic_loss"])
+    return out
+
+
 # Train-to-serve loop: the sparse DeepFM loop PLUS an
 # EmbeddingPublisher shipping the embedding table to a serving
 # replica as committed base/delta generations every
@@ -1442,6 +1748,34 @@ def sparse_kill_restore(seed: int = 61) -> Scenario:
     })
 
 
+def rl_rollout_worker_kill(seed: int = 97) -> Scenario:
+    """Elastic RL acceptance (ISSUE 16): SIGKILL the rollout worker
+    mid-PPO-iteration — on the ``rl.rollout`` hook of lease 2, after
+    the batch is generated but BEFORE it is buffered, checkpointed or
+    acked.  The master requeues the lease (journaled dispatch/ack);
+    the replacement restores the four-role state + partial buffer +
+    cursor from the flash checkpoint, REPLAYS the interrupted
+    iteration's train steps, regenerates the lost lease
+    bit-identically and finishes the budget.  Exactly-once rollout
+    accounting, the loss trajectory equal to the uninterrupted
+    control, and recovery-loss attribution are all decided from the
+    event log alone."""
+    return Scenario.from_dict({
+        "name": "rl-rollout-worker-kill",
+        "seed": seed,
+        "rules": [{
+            "name": "kill-rollout-midlease",
+            "point": "rl.rollout",
+            "action": "kill",
+            # lease 2 = the first lease AFTER a train phase: the
+            # restore must land on the post-lease-1 snapshot and
+            # replay PPO steps 1-2 (multi-incarnation loss agreement)
+            "at_step": 2,
+            "only_first_incarnation": True,
+        }],
+    })
+
+
 def sparse_spill_io_error(seed: int = 67) -> Scenario:
     """Graceful degradation (ISSUE 9): the spill tier's disk dies
     DURING a checkpoint export (io_error on the ``kv.spill`` hook).
@@ -1670,6 +2004,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     ),
     "warm_recovery_cache_hit": warm_recovery_cache_hit,
     "master_respawn_other_host": master_respawn_other_host,
+    "rl_rollout_worker_kill": rl_rollout_worker_kill,
 }
 
 
@@ -1833,6 +2168,19 @@ RUN_OPTIONS: Dict[str, Dict] = {
             "DLROVER_KV_DIGEST": "1",
             "DLROVER_KV_RESHARD_WINDOW_ROWS": "200",
         },
+    },
+    # elastic RL: 8 rollout leases = 8 PPO train steps (2 leases per
+    # iteration), so total_steps doubles as the lease-dataset size and
+    # the trainer's step budget; ckpt_every=2 is nominal — the RL loop
+    # flash-saves after EVERY lease, and the kill on lease 2 restores
+    # the post-lease-1 snapshot and replays PPO steps 1-2 before
+    # regenerating the lost lease.  compile_cache gives the respawn
+    # the AOT executable path for its actor/critic steps.
+    "rl-rollout-worker-kill": {
+        "total_steps": 8,
+        "ckpt_every": 2,
+        "train_script": "rl",
+        "compile_cache": True,
     },
     # spill-disk death mid-export: same loop + budget; the kill lands
     # at step 7 so the step-6 export (post-breaker, spill_disabled

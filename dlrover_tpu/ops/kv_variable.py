@@ -390,7 +390,15 @@ class KvVariable:
                 ))
                 if got <= 0:
                     break
-                yield keys[:got], values[:got], freq[:got]
+                out = (keys[:got], values[:got], freq[:got])
+                # drop the generator's own refs BEFORE yielding: a
+                # caller that releases the window promptly then pays
+                # for ONE live window during the next chunk's
+                # allocation, not two (the streamed writers' RSS
+                # bound leans on this)
+                keys = values = freq = None
+                yield out
+                out = None
                 if got < max_rows and not int(
                     self._lib.kv_export_cursor_remaining(cursor)
                 ):

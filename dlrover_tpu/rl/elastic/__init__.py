@@ -1,0 +1,37 @@
+"""Elastic control plane for the PPO loop (ISSUE 16).
+
+Three pieces make the four-role RLHF workload
+(:mod:`dlrover_tpu.rl`) a first-class elastic citizen:
+
+- **rollout leases** (:mod:`.lease`): rollout batches are
+  master-dispatched shard leases — a dead rollout worker's in-flight
+  batch requeues through the journaled dispatch/ack machinery and is
+  REGENERATED bit-identically (the batch is a pure function of the
+  lease id), so exactly-once rollout accounting is decidable from
+  ``shard_dispatch``/``shard_ack`` events alone;
+- **PPO-iteration flash checkpoints** (:mod:`.adapter`): the full
+  four-role state (actor+critic train states, RNG key, iteration
+  cursor, the partially-accumulated rollout buffer) rides the flash
+  engine through a :class:`PPOStateAdapter` duck-typing the sparse
+  adapter contract, so a mid-iteration kill restores to the last
+  completed rollout lease instead of iteration start;
+- **retrace-free recovery** (:func:`.lease.resolve_role_steps`): the
+  actor/critic train steps route through the AOT executable cache,
+  so an RL respawn deserializes its compiled steps like the dense
+  loop does.
+"""
+
+from dlrover_tpu.rl.elastic.adapter import PPOCursor, PPOStateAdapter
+from dlrover_tpu.rl.elastic.lease import (
+    lease_prompts,
+    lease_rng,
+    resolve_role_steps,
+)
+
+__all__ = [
+    "PPOCursor",
+    "PPOStateAdapter",
+    "lease_prompts",
+    "lease_rng",
+    "resolve_role_steps",
+]

@@ -1,0 +1,257 @@
+"""PPO-iteration flash checkpoints: the four-role state rides the
+flash engine through the sparse-adapter contract.
+
+:class:`PPOStateAdapter` duck-types the surface
+:class:`~dlrover_tpu.checkpoint.sparse.SparseStateAdapter` exposes to
+:class:`~dlrover_tpu.checkpoint.engine.CheckpointEngine` —
+``export_for_checkpoint`` / ``import_state`` / the delta-chain and
+cross-world hooks — so ``Checkpointer.register_sparse`` accepts it
+unchanged and the PPO state nests under the reserved ``__kv__`` key of
+every flash snapshot, alongside whatever dense state the script saves.
+
+What one snapshot carries (the ISSUE-16 contract):
+
+- both trainable roles' FULL train states (params + optimizer slots +
+  step counters) as donation-safe host copies;
+- the PPO cursor: rollout leases completed, PPO updates taken, and
+  the loop's RNG key — the coordinates a replacement needs to resume
+  at the last completed rollout lease rather than iteration start;
+- the partially-accumulated rollout buffer (the experience batches of
+  the in-flight iteration), so a mid-iteration kill loses at most the
+  single lease that was being generated — and THAT lease requeues
+  through the master and regenerates bit-identically.
+
+Cross-world restores ride the engine's storage-tier path: the import
+rebuilds each role against the engine's CURRENT train state as the
+template, so ``restore_to_template``'s batched ``device_put`` lands
+the actor's GSPMD state on the new world's shardings (the reshard is
+one placement, exactly like the dense path).
+"""
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.rl.model_engine import ModelRole
+
+ROLES_KEY = "__roles__"
+BUFFER_KEY = "__buffer__"
+CURSOR_KEY = "__cursor__"
+
+
+class PPOCursor:
+    """Where the PPO loop is, in lease coordinates.
+
+    ``leases_done`` counts rollout leases whose batch is IN the
+    buffer (or already trained on); ``ppo_updates`` counts completed
+    PPO train steps (the trainer's global step); ``rng_key`` is the
+    loop's root PRNG key as host numpy.  All three ride every flash
+    snapshot and come back on restore, so the replacement's very
+    first action — skip-and-ack an already-buffered lease, or train
+    on the restored buffer — is decided by the cursor, not by
+    guesswork."""
+
+    def __init__(self, leases_done: int = 0, ppo_updates: int = 0,
+                 rng_key: Optional[np.ndarray] = None):
+        self.leases_done = int(leases_done)
+        self.ppo_updates = int(ppo_updates)
+        self.rng_key = (
+            None if rng_key is None else np.array(rng_key)
+        )
+
+    def to_state(self) -> Dict[str, Any]:
+        out = {
+            "leases_done": int(self.leases_done),
+            "ppo_updates": int(self.ppo_updates),
+        }
+        if self.rng_key is not None:
+            out["rng_key"] = np.array(self.rng_key)
+        return out
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        self.leases_done = int(np.asarray(state["leases_done"]))
+        self.ppo_updates = int(np.asarray(state["ppo_updates"]))
+        key = state.get("rng_key")
+        self.rng_key = None if key is None else np.array(key)
+
+
+class PPOStateAdapter:
+    """Checkpoint adapter for an :class:`RLModelEngine` + replay
+    buffer + :class:`PPOCursor`.
+
+    ``include_roles=True`` (the default) carries the trainable roles'
+    train states in the snapshot — correct for replicated-host PPO
+    state (the single-worker RL job, or per-rank identical state).
+    Multi-host GSPMD actors should instead save their sharded train
+    state through the DENSE state dict (per-rank shards) and run the
+    adapter with ``include_roles=False`` so only buffer + cursor ride
+    the ``__kv__`` subtree."""
+
+    def __init__(self, engine, buffer=None, cursor=None,
+                 roles=(ModelRole.ACTOR, ModelRole.CRITIC),
+                 include_roles: bool = True):
+        self._engine = engine
+        self._buffer = buffer
+        self.cursor = cursor if cursor is not None else PPOCursor()
+        self._role_names = tuple(roles)
+        self._include_roles = include_roles
+
+    # -- export --------------------------------------------------------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """The PPO subtree of one flash snapshot: plain numpy leaves
+        only (forced host copies — the train steps DONATE their
+        state, so a zero-copy view would be invalidated by the next
+        step while the async writer still reads it)."""
+        import jax
+
+        out: Dict[str, Any] = {CURSOR_KEY: self.cursor.to_state()}
+        if self._include_roles:
+            out[ROLES_KEY] = {
+                role: jax.tree.map(
+                    lambda x: np.array(x), self._engine.state(role)
+                )
+                for role in self._role_names
+            }
+        batches: Dict[str, Any] = {}
+        if self._buffer is not None:
+            for i, batch in enumerate(self._buffer_batches()):
+                batches[f"b{i:04d}"] = {
+                    k: np.array(v) for k, v in batch.items()
+                }
+        if batches:
+            out[BUFFER_KEY] = batches
+        out[CURSOR_KEY]["buffer_batches"] = len(batches)
+        return out
+
+    def _buffer_batches(self) -> List[Dict[str, np.ndarray]]:
+        if self._buffer is None:
+            return []
+        if hasattr(self._buffer, "batches"):
+            return self._buffer.batches()
+        return list(self._buffer._batches)
+
+    def export_for_checkpoint(
+        self, step: Optional[int] = None,
+        rank: Optional[int] = None, durable: bool = False,
+    ) -> Dict[str, Any]:
+        """Engine entry point (mirrors the sparse adapter): every
+        save exports the full PPO subtree — there is no delta mode;
+        the state is a few MB of tiny-role params + buffer, and the
+        shm segment must stand alone."""
+        return self.export_state()
+
+    # -- import --------------------------------------------------------------
+
+    def import_state(
+        self, state: Dict[str, Any], tier: str = "",
+        step: Optional[int] = None, rank: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Rebuild engine states, buffer and cursor from a restored
+        (plain-nested-dict) subtree.  Role states rebuild against the
+        engine's CURRENT states as templates — ``restore_to_template``
+        re-types the optax containers and ``device_put``s onto the
+        current shardings, which IS the cross-world reshard when the
+        template's layout differs from the writer's."""
+        from dlrover_tpu.checkpoint.checkpointer import (
+            restore_to_template,
+        )
+
+        t0 = time.perf_counter()
+        roles = state.get(ROLES_KEY)
+        restored_roles = 0
+        if self._include_roles and roles:
+            for role in self._role_names:
+                saved = roles.get(role)
+                if saved is None:
+                    logger.warning(
+                        "PPO checkpoint step %s carries no %r role "
+                        "state; role left at its fresh init",
+                        step, role,
+                    )
+                    continue
+                template = self._engine.state(role)
+                self._engine.set_state(
+                    role, restore_to_template(template, saved)
+                )
+                restored_roles += 1
+        rows = 0
+        if self._buffer is not None:
+            self._buffer.reset()
+            batches = state.get(BUFFER_KEY) or {}
+            for name in sorted(batches):
+                self._buffer.add(batches[name])
+            rows = int(self._buffer.num)
+        cursor_state = state.get(CURSOR_KEY)
+        if cursor_state:
+            want = cursor_state.pop("buffer_batches", None)
+            self.cursor.load_state(cursor_state)
+            if want is not None and self._buffer is not None:
+                got = len(self._buffer._batches)
+                if int(np.asarray(want)) != got:
+                    raise RuntimeError(
+                        f"PPO checkpoint step {step} is torn: cursor "
+                        f"says {int(np.asarray(want))} buffered "
+                        f"batch(es), snapshot carries {got}"
+                    )
+        seconds = time.perf_counter() - t0
+        logger.info(
+            "PPO state restored from %s step %s: %d role(s), %d "
+            "buffered sample(s), cursor leases=%d updates=%d "
+            "(%.3fs)",
+            tier or "?", step, restored_roles, rows,
+            self.cursor.leases_done, self.cursor.ppo_updates,
+            seconds,
+        )
+        # lands in stats.extra -> the checkpoint_restore event and
+        # the timeline's "+kv" restore stage, same as sparse tables
+        return {
+            "kv_s": round(seconds, 4),
+            "kv_rows": rows,
+            "rl_roles": restored_roles,
+        }
+
+    # -- delta-chain / cross-world hooks (engine contract) -------------------
+
+    def delta_checkpoints_enabled(self) -> bool:
+        return False
+
+    def delta_full_every(self) -> int:
+        return 0
+
+    def checkpoint_chain_poison(self) -> None:
+        """No delta chain to poison — every export is a full base."""
+
+    def import_chain(
+        self, links: List[Dict[str, Any]], tier: str = "",
+        step: Optional[int] = None, rank: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Defensive: every PPO export is a full base, so a 'chain'
+        restore is just its newest link."""
+        return self.import_state(
+            links[-1], tier=tier, step=step, rank=rank
+        )
+
+    def import_shards_streaming(
+        self, chains: Dict[int, List[Dict[str, Any]]],
+        world_size: int = 1, rank: int = 0, from_world: int = 1,
+        tier: str = "storage", step: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Cross-world restore: PPO host state is replicated across
+        ranks (unlike kv shards), so any old rank's newest link is the
+        whole state — import rank 0's (or the lowest present) and let
+        ``restore_to_template`` place it on the new world's
+        shardings."""
+        if not chains:
+            raise RuntimeError(
+                f"cross-world PPO restore of step {step}: no source "
+                "shards readable"
+            )
+        src = chains[min(chains)]
+        info = self.import_state(
+            src[-1], tier=tier, step=step, rank=rank
+        )
+        info["rl_from_world"] = int(from_world)
+        return info

@@ -1,0 +1,81 @@
+"""Rollout leases: deterministic batch derivation + AOT step routing.
+
+A rollout lease is one master-dispatched shard task (the journaled
+dispatch/ack/requeue machinery of
+:class:`~dlrover_tpu.master.task_manager.TaskManager`) whose id IS
+the rollout's identity: prompts and the generation RNG both derive
+purely from the lease id, so a lease requeued off a dead worker and
+regenerated on its replacement produces the bit-identical experience
+batch — exactly-once rollout semantics without any rollout-side
+journal.
+"""
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def lease_prompts(
+    lease_id: int,
+    batch_size: int,
+    prompt_len: int,
+    vocab_size: int,
+    base_seed: int = 20_000,
+) -> np.ndarray:
+    """The prompt batch of one rollout lease — a pure function of the
+    lease id (counter-based PRNG), never of worker identity or
+    restart history."""
+    rng = np.random.default_rng(base_seed + int(lease_id))
+    return rng.integers(
+        0, vocab_size, (batch_size, prompt_len), dtype=np.int32
+    )
+
+
+def lease_rng(seed: int, lease_id: int):
+    """The generation PRNG key of one rollout lease: ``fold_in`` of
+    the job seed with the lease id — replayable on any incarnation,
+    independent of how many leases this worker saw before."""
+    import jax
+
+    return jax.random.fold_in(
+        jax.random.PRNGKey(int(seed)), int(lease_id)
+    )
+
+
+def resolve_role_steps(
+    engine,
+    batch: Dict,
+    roles=None,
+    cache_dir: Optional[str] = None,
+    label_prefix: str = "rl",
+) -> Dict[str, object]:
+    """Route the trainable roles' train steps through the AOT
+    executable cache (:func:`dlrover_tpu.common.aot_cache.
+    resolve_step`) so an RL respawn deserializes its compiled
+    actor/critic steps instead of re-tracing them — the same
+    retrace-free recovery the dense loop gets.
+
+    Returns ``{role: Resolution}``; call ``resolved[role].fn(state,
+    placed_batch)`` exactly like ``engine.train_step(role)``.  Each
+    role gets its own label (``rl_actor_step`` / ``rl_critic_step``),
+    so the warm fast path resolves per role without example builds."""
+    from dlrover_tpu.common.aot_cache import resolve_step
+    from dlrover_tpu.rl.model_engine import ModelRole
+
+    if roles is None:
+        roles = ModelRole.TRAINABLE
+    resolved = {}
+    for role in roles:
+        def example_args(role=role):
+            return (
+                engine.state(role),
+                engine.place_batch(role, batch),
+            )
+
+        resolved[role] = resolve_step(
+            engine.train_step(role),
+            example_args,
+            label=f"{label_prefix}_{role}_step",
+            cache_dir=cache_dir,
+        )
+    return resolved

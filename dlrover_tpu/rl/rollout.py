@@ -100,7 +100,13 @@ def make_experience(
     generation.  ``rollout_params`` (already-resharded actor params,
     e.g. from a phase hook) skips the per-call reshard — the actor
     does not train inside an experience phase, so one swap serves
-    every rollout of the phase.  Returns (batch dict, metrics)."""
+    every rollout of the phase.  Returns (batch dict, metrics);
+    metrics carry the measured phase seconds (``rollout_s`` =
+    generation, ``score_s`` = ref-KL + reward, ``gae_s`` = critic
+    values + GAE) feeding the elastic plane's ``rl_iteration``
+    timeline slices."""
+    import time as _time
+
     b, prompt_len = prompts.shape
     actor = engine._roles[ModelRole.ACTOR].model
     actor_decode = decode_variant(actor)
@@ -116,10 +122,13 @@ def make_experience(
     else:
         actor_params = engine.state(ModelRole.ACTOR).params
 
+    t0 = _time.perf_counter()
     sequences, old_logps = generate(
         actor_decode, actor_params, prompts, rng,
         max_new_tokens=max_new_tokens, temperature=temperature,
     )
+    jax.block_until_ready(old_logps)
+    t_rollout = _time.perf_counter()
 
     # reference logprobs over the response region (KL anchor)
     ref_logits = engine.infer(ModelRole.REF, sequences[:, :-1])
@@ -137,6 +146,8 @@ def make_experience(
     # per-token reward = -KL penalty, terminal reward on the last token
     kl = kl_penalty(old_logps, ref_lp, kl_coef)
     rewards = (-kl).at[:, -1].add(seq_reward)
+    jax.block_until_ready(rewards)
+    t_score = _time.perf_counter()
 
     critic_model = engine._roles[ModelRole.CRITIC].model
     critic_params = engine.state(ModelRole.CRITIC).params
@@ -155,9 +166,14 @@ def make_experience(
         "advantages": advantages,
         "returns": returns,
     }
+    jax.block_until_ready(returns)
+    t_gae = _time.perf_counter()
     metrics = {
         "mean_reward": float(seq_reward.mean()),
         "mean_kl": float(kl.mean()),
+        "rollout_s": round(t_rollout - t0, 4),
+        "score_s": round(t_score - t_rollout, 4),
+        "gae_s": round(t_gae - t_score, 4),
     }
     if fresh_reshard:
         metrics["reshard_s"] = hybrid.reshard_times[-1]
@@ -165,17 +181,22 @@ def make_experience(
 
 
 def train_on_batch(
-    engine: RLModelEngine, batch: Dict
+    engine: RLModelEngine, batch: Dict, steps: Dict = None
 ) -> Dict[str, float]:
     """TRAINING phase: one actor + one critic PPO step on an
     experience batch (reference: RLTrainer.rl_training inner
-    update)."""
+    update).  ``steps`` optionally maps role -> step callable (e.g.
+    AOT-cache resolutions from
+    :func:`dlrover_tpu.rl.elastic.resolve_role_steps`) in place of
+    the engine's jitted steps — same signature, same donation."""
     losses = {}
     for role in (ModelRole.ACTOR, ModelRole.CRITIC):
         placed = engine.place_batch(role, batch)
-        state, metrics = engine.train_step(role)(
-            engine.state(role), placed
+        step_fn = (
+            steps[role] if steps and role in steps
+            else engine.train_step(role)
         )
+        state, metrics = step_fn(engine.state(role), placed)
         engine.set_state(role, state)
         losses[f"{role}_loss"] = float(metrics["loss"])
     return losses

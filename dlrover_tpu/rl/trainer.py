@@ -56,6 +56,13 @@ class ReplayBuffer:
         self._merged = None
         self.num = 0
 
+    def batches(self) -> List[Dict[str, np.ndarray]]:
+        """The accumulated batches in INSERTION order — the elastic
+        plane's checkpoint adapter exports these, and the
+        deterministic chaos loop trains on them in this order so a
+        restored incarnation replays identical PPO steps."""
+        return list(self._batches)
+
     def minibatches(self, batch_size: int, rng: np.random.Generator):
         """Shuffled minibatches over the whole buffer; a short final
         remainder is dropped (jitted steps need static shapes)."""

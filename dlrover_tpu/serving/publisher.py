@@ -44,10 +44,14 @@ from dlrover_tpu import chaos as _chaos
 from dlrover_tpu.checkpoint.sparse import (
     SCALARS_KEY,
     keys_digest,
+    reshard_window_rows,
     rows_digest,
 )
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.storage import get_checkpoint_storage
+from dlrover_tpu.common.storage import (
+    PosixDiskStorage,
+    get_checkpoint_storage,
+)
 from dlrover_tpu.telemetry.events import emit_event
 from dlrover_tpu.telemetry.metrics import get_registry
 
@@ -243,6 +247,15 @@ class EmbeddingPublisher:
         # construction would otherwise silently never track (empty,
         # digest-clean deltas while replicas serve it stale)
         self.adapter.enable_dirty_tracking()
+        # a BASE on local disk streams straight from the tables into
+        # the blob zip (write-side twin of the replica's _NpyStream):
+        # peak extra memory is one export window, not a full table
+        # copy + its npz serialization.  Other backends keep the
+        # in-memory path (their write() wants whole buffers).
+        streamed = kind == "base" and isinstance(
+            self.storage, PosixDiskStorage
+        )
+        state: Dict[str, Any] = {}
         if kind == "base":
             # baseline BEFORE the export: a mutation racing the two
             # steps then lands in BOTH the base (table state) and the
@@ -252,7 +265,8 @@ class EmbeddingPublisher:
             # until the next compaction with every digest green.
             for table in self.adapter.tables.values():
                 table.clear_dirty()
-            state = self.adapter.export_state(step=step)
+            if not streamed:
+                state = self.adapter.export_state(step=step)
         else:
             state = self.adapter.export_delta(step=step, clear=True)
 
@@ -266,45 +280,61 @@ class EmbeddingPublisher:
 
         tables_meta: Dict[str, Any] = {}
         rows = dead_rows = 0
-        arrays: Dict[str, np.ndarray] = {}
         scalars = {}
-        for name, sub in state.items():
-            if not isinstance(sub, dict) or "keys" not in sub:
-                if name == SCALARS_KEY:
-                    scalars = sub
-                continue
-            keys = np.ascontiguousarray(sub["keys"], dtype=np.int64)
-            values = np.ascontiguousarray(
-                sub["values"], dtype=np.float32
+        if streamed:
+            rows, nbytes, tables_meta = self._write_base_streamed(
+                gen_dir
             )
-            freq = np.ascontiguousarray(sub["freq"], dtype=np.uint64)
-            dead = np.ascontiguousarray(
-                sub.get("dead", ()), dtype=np.int64
-            )
-            arrays[f"{name}::keys"] = keys
-            arrays[f"{name}::values"] = values
-            arrays[f"{name}::freq"] = freq
-            arrays[f"{name}::dead"] = dead
-            table = self.adapter.tables.get(name)
-            tables_meta[name] = {
-                "dim": int(
-                    table.dim if table is not None
-                    else (values.shape[1] if values.ndim == 2 else 0)
-                ),
-                "rows": int(keys.size),
-                "dead": int(dead.size),
-                "digest": f"{rows_digest(keys, values, freq):016x}",
-                "dead_digest": f"{keys_digest(dead):016x}",
-            }
-            rows += int(keys.size)
-            dead_rows += int(dead.size)
+            scalars = self._optimizer_scalars()
+        else:
+            arrays: Dict[str, np.ndarray] = {}
+            for name, sub in state.items():
+                if not isinstance(sub, dict) or "keys" not in sub:
+                    if name == SCALARS_KEY:
+                        scalars = sub
+                    continue
+                keys = np.ascontiguousarray(
+                    sub["keys"], dtype=np.int64
+                )
+                values = np.ascontiguousarray(
+                    sub["values"], dtype=np.float32
+                )
+                freq = np.ascontiguousarray(
+                    sub["freq"], dtype=np.uint64
+                )
+                dead = np.ascontiguousarray(
+                    sub.get("dead", ()), dtype=np.int64
+                )
+                arrays[f"{name}::keys"] = keys
+                arrays[f"{name}::values"] = values
+                arrays[f"{name}::freq"] = freq
+                arrays[f"{name}::dead"] = dead
+                table = self.adapter.tables.get(name)
+                tables_meta[name] = {
+                    "dim": int(
+                        table.dim if table is not None
+                        else (
+                            values.shape[1] if values.ndim == 2
+                            else 0
+                        )
+                    ),
+                    "rows": int(keys.size),
+                    "dead": int(dead.size),
+                    "digest": (
+                        f"{rows_digest(keys, values, freq):016x}"
+                    ),
+                    "dead_digest": f"{keys_digest(dead):016x}",
+                }
+                rows += int(keys.size)
+                dead_rows += int(dead.size)
 
-        buf = io.BytesIO()
-        np.savez(buf, **arrays)
-        blob_bytes = buf.getvalue()
-        self.storage.write(
-            blob_bytes, os.path.join(gen_dir, BLOBS)
-        )
+            buf = io.BytesIO()
+            np.savez(buf, **arrays)
+            blob_bytes = buf.getvalue()
+            self.storage.write(
+                blob_bytes, os.path.join(gen_dir, BLOBS)
+            )
+            nbytes = len(blob_bytes)
         table_rows = sum(
             len(t) for t in self.adapter.tables.values()
         )
@@ -316,7 +346,7 @@ class EmbeddingPublisher:
             "commit_ts": time.time(),
             "tables": tables_meta,
             "scalars": scalars,
-            "nbytes": len(blob_bytes),
+            "nbytes": int(nbytes),
             "table_rows": int(table_rows),
         }
         self.storage.write(
@@ -352,7 +382,7 @@ class EmbeddingPublisher:
             step=int(step) if step is not None else -1,
             rows=int(rows),
             dead_rows=int(dead_rows),
-            bytes=len(blob_bytes),
+            bytes=int(nbytes),
             seconds=round(seconds, 4),
             delta_ratio=delta_ratio,
             tables={
@@ -361,14 +391,150 @@ class EmbeddingPublisher:
             },
         )
         logger.info(
-            "published serving generation %d (%s): %d row(s), %d "
+            "published serving generation %d (%s%s): %d row(s), %d "
             "tombstone(s), %.1f KB in %.3fs",
-            gen, kind, rows, dead_rows, len(blob_bytes) / 1024,
-            seconds,
+            gen, kind, ", streamed" if streamed else "", rows,
+            dead_rows, nbytes / 1024, seconds,
         )
         if kind == "base":
             self._prune_before_base(gen)
         return gen
+
+    def _optimizer_scalars(self) -> Dict[str, Any]:
+        """The manifest's optimizer-scalar section, computed without
+        a full :meth:`export_state` (the streamed base path never
+        materializes one)."""
+        from dlrover_tpu.checkpoint.sparse import _enc
+
+        return {
+            _enc(opt.table.name): opt.state_scalars()
+            for opt in getattr(self.adapter, "_optimizers", ())
+            if hasattr(opt, "state_scalars")
+        }
+
+    def _write_base_streamed(self, gen_dir: str):
+        """Write-side twin of the replica's ``_NpyStream``: assemble
+        the base blob zip member-by-member, the values column
+        streamed straight off :meth:`KvVariable.export_chunks`
+        windows.  Peak extra memory is ONE window plus the 16 B/row
+        key+freq sidecars — never the full value matrix copy (plus
+        its npz serialization) the in-memory path costs.  The
+        manifest digest accumulates per window (``rows_digest`` sums
+        mod 2**64 over disjoint row sets), so replicas verify the
+        streamed blob exactly like a materialized one.  Same commit
+        discipline as ``storage.write``: temp file + atomic rename.
+        Returns ``(rows, nbytes, tables_meta)``."""
+        import tempfile
+        import zipfile
+
+        from numpy.lib import format as npformat
+
+        def write_member(zf, member, dtype, shape, blocks):
+            got = 0
+            with zf.open(member, "w", force_zip64=True) as fh:
+                npformat.write_array_header_1_0(fh, {
+                    "descr": npformat.dtype_to_descr(
+                        np.dtype(dtype)
+                    ),
+                    "fortran_order": False,
+                    "shape": tuple(int(d) for d in shape),
+                })
+                for block in blocks:
+                    block = np.ascontiguousarray(block, dtype=dtype)
+                    # flat byte view, not tobytes(): no window-sized
+                    # copy on the hot path
+                    fh.write(memoryview(block).cast("B"))
+                    got += int(block.shape[0]) if block.ndim else 0
+                    # release before pulling the next window, or the
+                    # loop var pins TWO windows across the generator
+                    # resume
+                    block = None
+            return got
+
+        # parity with storage.write: the chaos io_error/stall rules
+        # that target blob writes must see the streamed path too
+        dest = os.path.join(gen_dir, BLOBS)
+        _chaos.fire("storage.write", path=dest)
+        self.storage.safe_makedirs(gen_dir)
+        fd, tmp = tempfile.mkstemp(dir=gen_dir, suffix=".blobs.tmp")
+        os.close(fd)
+        tables_meta: Dict[str, Any] = {}
+        rows = 0
+        no_dead = np.empty(0, dtype=np.int64)
+        try:
+            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED) as zf:
+                for name, table in self.adapter.tables.items():
+                    n = len(table)
+                    dim = int(table.dim)
+                    window = reshard_window_rows(dim * 4 + 16)
+                    key_parts, freq_parts = [], []
+                    digest = 0
+
+                    def value_blocks(table=table, window=window):
+                        nonlocal digest
+                        for k, v, f in table.export_chunks(window):
+                            key_parts.append(
+                                np.ascontiguousarray(
+                                    k, dtype=np.int64
+                                )
+                            )
+                            freq_parts.append(
+                                np.ascontiguousarray(
+                                    f, dtype=np.uint64
+                                )
+                            )
+                            digest = (
+                                digest + rows_digest(k, v, f)
+                            ) % 2**64
+                            yield v
+                            k = v = f = None
+
+                    got = write_member(
+                        zf, f"{name}::values.npy", np.float32,
+                        (n, dim), value_blocks(),
+                    )
+                    keys = (
+                        np.concatenate(key_parts) if key_parts
+                        else np.empty(0, dtype=np.int64)
+                    )
+                    freq = (
+                        np.concatenate(freq_parts) if freq_parts
+                        else np.empty(0, dtype=np.uint64)
+                    )
+                    if got != n or int(keys.size) != n:
+                        # the values header already promised n rows;
+                        # a mismatched stream would commit a blob the
+                        # replica reads torn — refuse the publish
+                        raise RuntimeError(
+                            f"streamed base export of table {name!r}"
+                            f" saw {got} row(s), the logical table "
+                            f"claims {n} — mutation mid-publish?"
+                        )
+                    write_member(
+                        zf, f"{name}::keys.npy", np.int64, (n,),
+                        [keys],
+                    )
+                    write_member(
+                        zf, f"{name}::freq.npy", np.uint64, (n,),
+                        [freq],
+                    )
+                    write_member(
+                        zf, f"{name}::dead.npy", np.int64, (0,), [],
+                    )
+                    tables_meta[name] = {
+                        "dim": dim,
+                        "rows": n,
+                        "dead": 0,
+                        "digest": f"{digest:016x}",
+                        "dead_digest": f"{keys_digest(no_dead):016x}",
+                    }
+                    rows += n
+            os.replace(tmp, dest)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return rows, os.path.getsize(dest), tables_meta
 
     def _prune_before_base(self, base_gen: int):
         """Drop committed generations a cold replica no longer needs:

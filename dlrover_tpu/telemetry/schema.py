@@ -98,6 +98,13 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         # (open dict: data_wait / h2d / compute / checkpoint /
         # report / other_s / total_s, arbitrary user phases allowed)
         _s("step_phases", ["step", "node_rank"], allow_extra=True),
+        # one completed PPO iteration of the elastic RL loop: the
+        # measured phase seconds (rollout / score / gae / train) give
+        # the timeline its RL phase slices, so recovery losses book
+        # against real iteration anatomy instead of a flat gap
+        _s("rl_iteration", ["iteration", "restart_count", "node_rank"],
+           ["leases", "rollout_s", "score_s", "gae_s", "train_s",
+            "actor_loss", "critic_loss"]),
         # -- checkpoint (open phase dicts: stage timings vary) -------
         _s("checkpoint_shm_save", ["step", "rank"],
            allow_extra=True),

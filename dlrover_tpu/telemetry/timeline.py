@@ -93,6 +93,13 @@ CAT_STEP = "train_step"
 # trainer side, ingest slices on the replica side — display
 # categories (serving work is not training goodput loss)
 CAT_SERVING = "serving"
+# elastic RL plane: per-iteration phase anatomy (rollout / score /
+# gae / train) from rl_iteration events — a DISPLAY category outside
+# CAUSE_PRIORITY (RL phases are productive work, not loss; recovery
+# seconds stay booked under restart/restore/rendezvous)
+CAT_RL = "rl_phase"
+# phase order of one PPO iteration, laid backward from the event ts
+RL_PHASES = ("rollout", "score", "gae", "train")
 # the measured death->first-step budget from the trainer-side
 # RecoveryProfiler: per-phase sub-slices of a restart window.  A
 # DISPLAY category, deliberately outside CAUSE_PRIORITY: the same
@@ -205,6 +212,28 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
                     "step", "freshness_s", "delta_ratio",
                 ) if e.get(k) is not None},
             ))
+            continue
+        if etype == "rl_iteration":
+            # emitted when a PPO iteration's train phase completes:
+            # lay the phase slices end-to-end BACKWARD from the event
+            # timestamp (train abuts ts, gae/score/rollout precede
+            # it), one slice per phase that measured nonzero
+            end = ts
+            for phase in reversed(RL_PHASES):
+                secs = _num(e.get(f"{phase}_s"))
+                if secs <= 0:
+                    continue
+                tl.slices.append(Slice(
+                    name=f"rl[{phase}] iter {e.get('iteration')}",
+                    cat=CAT_RL,
+                    start=end - secs, end=end,
+                    track=track,
+                    meta={k: e.get(k) for k in (
+                        "iteration", "leases", "actor_loss",
+                        "critic_loss", "restart_count",
+                    ) if e.get(k) is not None},
+                ))
+                end -= secs
             continue
         if etype == "recovery_phase":
             # emitted at phase END with the measured duration: the
@@ -971,6 +1000,24 @@ def to_report(
                 f"  node{rank} restart#{count}: {total:.3f}s  "
                 f"({parts}){cache_txt}{aot_txt}"
             )
+    rl = tl.slices_by_cat(CAT_RL)
+    if rl:
+        iters = {
+            s.meta.get("iteration") for s in rl
+            if s.meta.get("iteration") is not None
+        }
+        by_phase = {}
+        for s in rl:
+            for p in RL_PHASES:
+                if s.name.startswith(f"rl[{p}]"):
+                    by_phase[p] = by_phase.get(p, 0.0) + s.duration
+        parts = "  ".join(
+            f"{p}={by_phase[p]:.3f}s" for p in RL_PHASES
+            if p in by_phase
+        )
+        lines.append(
+            f"rl plane: {len(iters)} iteration(s)  ({parts})"
+        )
     serving = tl.slices_by_cat(CAT_SERVING)
     if serving:
         publishes = [

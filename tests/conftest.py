@@ -61,7 +61,7 @@ def pytest_collection_modifyitems(config, items):
         "test_resize.py", "test_sparse_checkpoint.py",
         "test_serving.py", "test_streaming_sparse.py",
         "test_recovery.py", "test_aot_cache.py",
-        "test_slo.py", "test_fleet.py",
+        "test_slo.py", "test_fleet.py", "test_rl_elastic.py",
         # the chaos acceptance e2e runs (worker kill, shm fallback,
         # master kill/restart) are the recovery regression net — a
         # truncated window must drop jit heavyweights, not these

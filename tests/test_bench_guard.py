@@ -92,6 +92,17 @@ def _fat_snapshot() -> dict:
         },
         "gqa_attention_kernel": {"seq2048": {"speedup": 1.812345}},
         "attention_kernel": {"seq8192": {"flash_vs_xla_speedup": 2.9}},
+        "rl_elastic": {
+            "recovery_s": 4.712345,
+            "goodput_pct": 91.212345,
+            "lost_s": 6.812345,
+            "iterations": 6,
+            "iter_train_s": 0.412345,
+        },
+        "xl_act_offload": {
+            "offload": {"tokens_per_s": 1234.567891},
+            "plain_remat_control": {"tokens_per_s": 987.654321},
+        },
         "elastic_recovery": {
             "recovery_s": 3.612345,
             "retrace_s": 1.103456,
@@ -113,7 +124,7 @@ def _fat_snapshot() -> dict:
         "xl_act_offload", "flash_ckpt", "auto_config", "sparse_kv",
         "input_pipeline", "gqa_attention_kernel", "attention_kernel",
         "elastic_recovery", "serving", "sparse_scale", "multislice",
-        "sequence_parallel",
+        "sequence_parallel", "rl_elastic",
     ]
     for name in sections:
         snap[f"{name}_error"] = "boom " * 50

@@ -740,3 +740,52 @@ def test_serving_trainer_kill_midpublish(tmp_path):
     assert report.job_timeline is not None
     serving_slices = report.job_timeline.slices_by_cat(CAT_SERVING)
     assert serving_slices, "no serving slices on the timeline"
+
+
+def test_rl_rollout_worker_kill(tmp_path):
+    """ISSUE 16 acceptance (tier-1): SIGKILL the PPO rollout worker
+    mid-iteration — on lease 2's ``rl.rollout`` hook, after the
+    experience batch is generated but before it is buffered, flash-
+    checkpointed or acked.  The master requeues the lease off the
+    dead worker; the replacement restores the four-role state +
+    partial buffer + cursor from the post-lease-1 flash snapshot,
+    replays the interrupted iteration's PPO steps, regenerates the
+    lost lease bit-identically, and finishes the budget with the
+    loss trajectory EQUAL to the uninterrupted control.  Exactly-once
+    lease accounting and recovery-loss attribution are decided from
+    the event log alone (invariants in the harness)."""
+    report = harness.run_scenario(
+        scenarios.rl_rollout_worker_kill(seed=97),
+        workdir=str(tmp_path / "run"),
+        monitor_interval=0.3,
+    )
+    assert report.ok, report.summary()
+    # exactly one seeded kill, on the rollout hook of lease 2
+    assert len(report.timeline) == 1, report.timeline
+    _seq, point, _rule, action, step = report.timeline[0]
+    assert point == "rl.rollout" and action == "kill"
+    assert step == 2
+    # the RL plane reported its iteration anatomy, across BOTH
+    # incarnations (the replay re-trains the restored buffer)
+    iters = [
+        e for e in report.events if e.get("type") == "rl_iteration"
+    ]
+    assert iters, "no rl_iteration events"
+    assert {e["restart_count"] for e in iters} == {0, 1}, iters
+    assert all(
+        e["rollout_s"] >= 0 and e["train_s"] > 0 for e in iters
+    ), iters
+    # RL phase slices landed on the assembled timeline
+    from dlrover_tpu.telemetry.timeline import CAT_RL
+
+    assert report.job_timeline is not None
+    rl_slices = report.job_timeline.slices_by_cat(CAT_RL)
+    assert rl_slices, "no rl phase slices on the timeline"
+    # the run really finished: the final PPO update committed durably
+    steps = scenarios.RUN_OPTIONS["rl-rollout-worker-kill"][
+        "total_steps"
+    ]
+    final_step, shards = read_last_checkpoint(
+        str(tmp_path / "run" / "ckpt")
+    )
+    assert final_step == steps and 0 in shards

@@ -1,0 +1,242 @@
+"""Elastic RL plane units (ISSUE 16): lease-replay bit-identity, the
+PPO checkpoint adapter's save/restore round trip, the uninterrupted
+control's loss trajectory, and the retrace-free plumbing
+(``_jitted_apply`` cache bounds + AOT-routed role steps).
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accel import Strategy
+from dlrover_tpu.models.gpt import GPT, GPTConfig
+from dlrover_tpu.rl.elastic import (
+    PPOCursor,
+    PPOStateAdapter,
+    lease_prompts,
+    lease_rng,
+    resolve_role_steps,
+)
+from dlrover_tpu.rl.model_engine import (
+    ModelRole,
+    RLModelEngine,
+    RoleSpec,
+)
+from dlrover_tpu.rl.rollout import (
+    make_actor_loss,
+    make_critic_loss,
+    make_experience,
+    sample_rollout_batch,
+    train_on_batch,
+)
+from dlrover_tpu.rl.trainer import ReplayBuffer
+
+B, PROMPT_LEN, MAX_NEW, VOCAB = 8, 4, 8, 32
+
+
+def _build_engine():
+    """The chaos loop's four-role recipe, shrunk for unit pacing."""
+    actor_cfg = GPTConfig.tiny(max_seq_len=16, vocab_size=VOCAB)
+    actor_model = GPT(actor_cfg)
+    critic_model = GPT(
+        GPTConfig.tiny(max_seq_len=16, vocab_size=VOCAB,
+                       head="value")
+    )
+    ref_model = GPT(actor_cfg)
+    ref_params = actor_model.init_params(jax.random.PRNGKey(1))
+    sample = sample_rollout_batch(
+        jnp.zeros((B, PROMPT_LEN), jnp.int32), MAX_NEW
+    )
+    dp = Strategy(opts=[("parallel_mode", {})])
+    return RLModelEngine(sample, {
+        ModelRole.ACTOR: RoleSpec(
+            model=actor_model,
+            loss_fn=make_actor_loss(actor_model, PROMPT_LEN),
+            optim_factory=lambda: optax.adam(5e-3),
+            strategy=dp,
+        ),
+        ModelRole.CRITIC: RoleSpec(
+            model=critic_model,
+            loss_fn=make_critic_loss(critic_model, PROMPT_LEN),
+            optim_factory=lambda: optax.adam(1e-3),
+            strategy=dp,
+        ),
+        ModelRole.REF: RoleSpec(model=ref_model, params=ref_params),
+    }).build()
+
+
+def _reward_fn(sequences):
+    resp = sequences[:, PROMPT_LEN:]
+    return (resp < 16).mean(axis=1).astype(jnp.float32)
+
+
+def _lease_batch(engine, lease_id, seed=2):
+    batch, _metrics = make_experience(
+        engine,
+        jnp.asarray(lease_prompts(lease_id, B, PROMPT_LEN, VOCAB)),
+        lease_rng(seed, lease_id), max_new_tokens=MAX_NEW,
+        kl_coef=0.01, reward_fn=_reward_fn,
+    )
+    return batch
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return _build_engine()
+
+
+def test_lease_derivation_is_pure():
+    """Prompts and RNG derive from the lease id alone — same id, same
+    bits; different ids, different bits (the requeue path's
+    exactly-once regeneration contract)."""
+    a = lease_prompts(3, B, PROMPT_LEN, VOCAB)
+    b = lease_prompts(3, B, PROMPT_LEN, VOCAB)
+    c = lease_prompts(4, B, PROMPT_LEN, VOCAB)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    k1 = np.asarray(lease_rng(2, 3))
+    k2 = np.asarray(lease_rng(2, 3))
+    k3 = np.asarray(lease_rng(2, 4))
+    np.testing.assert_array_equal(k1, k2)
+    assert not np.array_equal(k1, k3)
+
+
+def test_lease_replay_bit_identical(engine):
+    """A requeued lease regenerated on a REPLACEMENT engine (fresh
+    build, identical init) is bit-identical to the original — tokens,
+    logprobs, advantages and returns all byte-equal."""
+    other = _build_engine()
+    first = _lease_batch(engine, lease_id=2)
+    replay = _lease_batch(other, lease_id=2)
+    assert first.keys() == replay.keys()
+    for k in first:
+        np.testing.assert_array_equal(
+            np.asarray(first[k]), np.asarray(replay[k]),
+            err_msg=f"lease replay diverged on {k}",
+        )
+
+
+def test_adapter_round_trip_restores_everything(engine):
+    """Export -> the REAL shm flatten/unflatten (typed pytrees out,
+    plain path-keyed dicts back) -> import on perturbed state must
+    restore role params, optimizer slots, the RNG key, the cursor and
+    the partial buffer — and report its stats through the ``kv_*``
+    extras."""
+    from dlrover_tpu.checkpoint.shm_handler import (
+        _flatten_state_dict,
+        _unflatten_to_nested,
+    )
+
+    buffer = ReplayBuffer()
+    buffer.add(_lease_batch(engine, 0))
+    buffer.add(_lease_batch(engine, 1))
+    cursor = PPOCursor(
+        leases_done=2, ppo_updates=0,
+        rng_key=np.asarray(jax.random.PRNGKey(2)),
+    )
+    adapter = PPOStateAdapter(engine, buffer, cursor)
+    exported = adapter.export_state()
+    snap_actor = jax.tree.map(
+        np.array, engine.state(ModelRole.ACTOR)
+    )
+
+    # perturb: train on both buffered batches (params + opt slots +
+    # step counters all move), drain the buffer, advance the cursor
+    for bt in buffer.batches():
+        train_on_batch(engine, bt)
+    buffer.reset()
+    cursor.leases_done, cursor.ppo_updates = 5, 3
+    cursor.rng_key = None
+    moved = jax.tree.map(np.array, engine.state(ModelRole.ACTOR))
+    leaves_pre = jax.tree_util.tree_leaves(snap_actor)
+    leaves_post = jax.tree_util.tree_leaves(moved)
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(leaves_pre, leaves_post)
+    ), "perturbation did not move the actor state"
+
+    flat = pickle.loads(pickle.dumps(_flatten_state_dict(exported)))
+    restored = _unflatten_to_nested(flat)
+    info = adapter.import_state(restored, tier="memory", step=0)
+    assert info["rl_roles"] == 2 and info["kv_rows"] == 2 * B
+
+    back = jax.tree.map(np.array, engine.state(ModelRole.ACTOR))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(snap_actor),
+        jax.tree_util.tree_leaves(back),
+    ):
+        np.testing.assert_array_equal(a, b)
+    assert cursor.leases_done == 2 and cursor.ppo_updates == 0
+    np.testing.assert_array_equal(
+        cursor.rng_key, np.asarray(jax.random.PRNGKey(2))
+    )
+    assert len(buffer.batches()) == 2 and buffer.num == 2 * B
+
+
+def test_adapter_detects_torn_snapshot(engine):
+    """A snapshot whose cursor claims more buffered batches than the
+    subtree carries is torn — the import must refuse it rather than
+    resume from silently-shortened experience."""
+    buffer = ReplayBuffer()
+    buffer.add(_lease_batch(engine, 0))
+    adapter = PPOStateAdapter(
+        engine, buffer, PPOCursor(leases_done=1),
+        include_roles=False,
+    )
+    exported = adapter.export_state()
+    from dlrover_tpu.rl.elastic.adapter import BUFFER_KEY
+
+    exported.pop(BUFFER_KEY)
+    with pytest.raises(RuntimeError, match="torn"):
+        adapter.import_state(exported, tier="memory", step=1)
+
+
+def test_reference_losses_shape_and_determinism():
+    """The uninterrupted control produces exactly one loss per lease
+    (train steps == leases) and is deterministic across calls — the
+    property LossTrajectoryMatches leans on."""
+    from dlrover_tpu.chaos.scenarios import rl_reference_losses
+
+    a = rl_reference_losses(2)
+    b = rl_reference_losses(2)
+    assert len(a) == 2
+    assert a == b
+
+
+def test_jitted_apply_cache_bounded(engine):
+    """``_jitted_apply`` memoizes per module (same module -> the SAME
+    jitted callable, no retrace) and its lru_cache stays bounded, so
+    module churn cannot leak compiled executables."""
+    from dlrover_tpu.rl.rollout import _jitted_apply
+
+    critic = engine._roles[ModelRole.CRITIC].model
+    assert _jitted_apply(critic) is _jitted_apply(critic)
+    info = _jitted_apply.cache_info()
+    assert info.maxsize == 8
+    assert info.currsize <= info.maxsize
+
+
+def test_resolve_role_steps_aot_routing(engine, tmp_path):
+    """Both trainable roles resolve through the AOT cache with
+    per-role labels; the resolved callables are drop-in train steps
+    (state out, loss metric out) accepted by ``train_on_batch``."""
+    batch = _lease_batch(engine, 7)
+    resolved = resolve_role_steps(
+        engine, batch, cache_dir=str(tmp_path)
+    )
+    assert set(resolved) == set(ModelRole.TRAINABLE)
+    for role, res in resolved.items():
+        assert res.source in ("aot", "trace", "off")
+        placed = engine.place_batch(role, batch)
+        state, metrics = res.fn(engine.state(role), placed)
+        assert np.isfinite(float(metrics["loss"]))
+        engine.set_state(role, state)
+    losses = train_on_batch(
+        engine, batch,
+        steps={r: res.fn for r, res in resolved.items()},
+    )
+    assert set(losses) == {"actor_loss", "critic_loss"}

@@ -438,6 +438,79 @@ def test_windowed_reshard_memory_guard():
     )
 
 
+def test_streamed_base_publish_memory_guard(tmp_path, monkeypatch):
+    """The write side of the streaming story: a BASE serving publish
+    of a ~20 MB table through the streamed zip writer stays under
+    2x the export window of extra RSS, while the in-memory fallback
+    (non-posix storage) materializes the whole table and blows past
+    the same bound — and a replica ingesting the streamed generation
+    serves bit-identical rows."""
+    from dlrover_tpu.common.env_utils import PeakRssSampler
+    from dlrover_tpu.serving import EmbeddingPublisher, ServingReplica
+
+    rows, dim = 40000, 128
+    window_mb = 8
+    window_rows = int(window_mb * 2**20 / (dim * 4 + 16))
+    monkeypatch.setenv(
+        "DLROVER_KV_RESHARD_WINDOW_ROWS", str(window_rows)
+    )
+    rng = np.random.default_rng(7)
+    keys = np.arange(rows, dtype=np.int64)
+    values = rng.normal(size=(rows, dim)).astype(np.float32)
+
+    def fresh():
+        t = KvVariable(dim, name="emb")
+        t.insert(keys, values)
+        return t, SparseStateAdapter(digest=True).register_table(t)
+
+    # streamed leg: default storage on a local path is posix -> the
+    # windowed zip writer; peak extra RSS bounded by the window
+    t_s, a_s = fresh()
+    pub = EmbeddingPublisher(a_s, str(tmp_path / "s_stream"))
+    with PeakRssSampler() as rss_stream:
+        gen = pub.publish(step=1)
+    bound = 2 * window_mb * 2**20
+    assert rss_stream.peak_extra_bytes <= bound, (
+        f"streamed base publish peak extra RSS "
+        f"{rss_stream.peak_extra_bytes / 2**20:.1f} MB > 2x window "
+        f"{2 * window_mb} MB"
+    )
+
+    # fallback leg: a delegating wrapper that is NOT a
+    # PosixDiskStorage forces the in-memory export path on the SAME
+    # table size — it must exceed the bound, or the guard above is
+    # not measuring anything
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    class BufferedStorage:
+        def __init__(self):
+            self._inner = PosixDiskStorage()
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    t_f, a_f = fresh()
+    pub_f = EmbeddingPublisher(
+        a_f, str(tmp_path / "s_fallback"),
+        storage=BufferedStorage(),
+    )
+    with PeakRssSampler() as rss_fallback:
+        pub_f.publish(step=1)
+    assert rss_fallback.peak_extra_bytes > bound, (
+        f"in-memory publish only used "
+        f"{rss_fallback.peak_extra_bytes / 2**20:.1f} MB — the "
+        "streamed guard is not discriminating (table too small?)"
+    )
+
+    # correctness: a replica ingests the streamed generation (its
+    # windowed reader verifies the manifest digests) and serves the
+    # exact source rows
+    rep = ServingReplica(str(tmp_path / "s_stream"))
+    assert rep.ingest_pending() == [gen]
+    out = rep.lookup(keys)
+    np.testing.assert_array_equal(out, values)
+
+
 # -- engine round trip with delta chains ---------------------------------
 
 
