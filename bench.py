@@ -1816,6 +1816,169 @@ def bench_serving(results: dict, workdir: str):
     out["lookup_batches_under_ingest"] = len(busy)
 
 
+def bench_serving_fleet(results: dict, workdir: str):
+    """Serving fleet (ISSUE 17): routed-lookup capacity of the
+    replica pool behind the freshness-aware router, over the real
+    framed-pickle transport on host cores.
+
+    1. **QPS scaling** — routed throughput at pool size N=1/2/4 with
+       a modeled per-batch device-gather floor on every replica
+       (``--lookup-floor-ms``).  The router keeps ONE pooled
+       connection per member (fail-fast, serialized roundtrips), so
+       per-member routed throughput is floor-bound and fleet capacity
+       must scale with the pool even on a host-core box where raw
+       loopback RPC would not.
+    2. **Zero-downtime re-base tail** — p99 while the publisher's
+       compaction forces every replica through the drain-before-
+       re-base protocol (serialized by the router's ``min_available``
+       gate) vs the quiet p99 at the same pool size, plus the
+       client-visible failure count, which must be zero."""
+    import numpy as np
+
+    from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+    from dlrover_tpu.fleet.lookup_load import LookupLoadHarness
+    from dlrover_tpu.ops.kv_variable import KvVariable
+    from dlrover_tpu.serving import EmbeddingPublisher
+    from dlrover_tpu.serving.pool import ReplicaPool
+    from dlrover_tpu.serving.router import LookupRouter
+
+    smoke = bool(os.getenv("BENCH_SMOKE"))
+    out: dict = {}
+    results["serving_fleet"] = out
+    rows, dim = 4000, 16
+    floor_ms = float(os.getenv("BENCH_FLEET_FLOOR_MS", "2.0"))
+    measure_s = 2.0 if smoke else 4.0
+    sizes = (1, 2) if smoke else (1, 2, 4)
+    out["lookup_floor_ms"] = floor_ms
+    out["rows"] = rows
+
+    base = os.path.join(workdir, "serving_fleet")
+    serving_dir = os.path.join(base, "pub")
+    rng = np.random.default_rng(0)
+    table = KvVariable(dim, initial_capacity=rows * 2, name="emb")
+    table.enable_dirty_tracking()
+    table.insert(
+        np.arange(rows, dtype=np.int64),
+        rng.normal(size=(rows, dim)).astype(np.float32),
+    )
+    adapter = SparseStateAdapter(digest=True).register_table(table)
+    # small compact_every so the re-base phase's publishes hit a
+    # compaction (full base reload -> the drain protocol) quickly
+    pub = EmbeddingPublisher(adapter, serving_dir, compact_every=3)
+    pub.publish(step=0)
+
+    def _wait_admitted(router, n, timeout_s=30.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            live = [
+                m for m in router.table.members.values()
+                if not m.removed and not m.draining
+                and not m.suspect and m.generation >= 0
+                and m.last_seen > 0.0
+            ]
+            if len(live) >= n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"{n} replicas not admitted in time")
+
+    qps_by_n: dict = {}
+    quiet_p99 = None
+    for n in sizes:
+        router = LookupRouter(
+            journal_dir=os.path.join(base, f"journal_n{n}"),
+            heartbeat_timeout_s=3.0, stats_every_s=60.0,
+            min_available=1,
+        )
+        pool = ReplicaPool(
+            serving_dir, os.path.join(base, f"pool_n{n}"),
+            router_addr=f"127.0.0.1:{router.port}", size=n,
+            heartbeat_s=0.25, lookup_floor_ms=floor_ms,
+            stats_every_s=60.0, max_respawns=0, router=router,
+        )
+        try:
+            pool.wait_ports(timeout_s=60.0)
+            _wait_admitted(router, n)
+            load = LookupLoadHarness(
+                f"127.0.0.1:{router.port}",
+                streams=2 * n + 2, batch=128, key_space=rows,
+                retries=4, seed=n,
+            )
+            s = load.run_for(measure_s)
+            qps_by_n[n] = s["qps"]
+            out[f"n{n}"] = {
+                "qps": s["qps"], "p50_ms": s.get("p50_ms"),
+                "p99_ms": s.get("p99_ms"), "failed": s["failed"],
+                "lookups": s["lookups"], "streams": s["streams"],
+            }
+            if n == 2:
+                quiet_p99 = s.get("p99_ms")
+                # re-base under load: publish a delta chain through a
+                # compaction; both replicas drain-and-reload one at a
+                # time behind the router's min_available gate while
+                # the streams keep hammering
+                load2 = LookupLoadHarness(
+                    f"127.0.0.1:{router.port}",
+                    streams=2 * n + 2, batch=128, key_space=rows,
+                    retries=4, seed=100 + n,
+                )
+                load2.start()
+                n_gens = 4
+                for g in range(1, n_gens + 1):
+                    touched = rng.choice(
+                        rows, size=256, replace=False
+                    ).astype(np.int64)
+                    table.scatter_add(
+                        touched,
+                        rng.normal(
+                            size=(len(touched), dim)
+                        ).astype(np.float32),
+                    )
+                    pub.publish(step=g)
+                    time.sleep(0.4)
+                # every member back at the newest generation = the
+                # re-base cycle (drain -> reload -> re-admit) is done
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    live = [
+                        m for m in router.table.members.values()
+                        if not m.removed
+                    ]
+                    if live and all(
+                        m.generation >= pub.generation
+                        and not m.draining for m in live
+                    ):
+                        break
+                    time.sleep(0.1)
+                load2.stop()
+                s2 = load2.summary()
+                reb = {
+                    "qps": s2["qps"], "p50_ms": s2.get("p50_ms"),
+                    "p99_ms": s2.get("p99_ms"),
+                    "failed": s2["failed"],
+                    "lookups": s2["lookups"],
+                    "generations": n_gens,
+                    "outcomes": s2["outcomes"],
+                }
+                if quiet_p99 and s2.get("p99_ms"):
+                    reb["p99_over_quiet_x"] = round(
+                        s2["p99_ms"] / quiet_p99, 2
+                    )
+                out["rebase"] = reb
+        finally:
+            pool.stop()
+            router.stop()
+
+    if 1 in qps_by_n and 2 in qps_by_n and qps_by_n[1]:
+        out["scaling_1_to_2_x"] = round(
+            qps_by_n[2] / qps_by_n[1], 2
+        )
+    if 2 in qps_by_n and 4 in qps_by_n and qps_by_n[2]:
+        out["scaling_2_to_4_x"] = round(
+            qps_by_n[4] / qps_by_n[2], 2
+        )
+    out["max_qps"] = max(qps_by_n.values()) if qps_by_n else None
+
+
 def bench_sparse_scale(results: dict, workdir: str):
     """Streaming sparse state at scale (ISSUE 14): the bulk-data
     paths of a spill-backed table built ≥ 4x its DRAM budget (real
@@ -2778,6 +2941,17 @@ def _headline(snapshot: dict) -> dict:
         _dig(snapshot, "serving", "lookup_p99_under_ingest_ms"),
     )
     put("delta_ratio", _dig(snapshot, "serving", "delta_ratio"))
+    # serving fleet: routed capacity at the largest pool, the 1->2
+    # replica scaling factor, and the routed p99 while the pool
+    # cycles through a drained re-base under load (ISSUE 17)
+    put(
+        "serving_fleet_qps",
+        _dig(snapshot, "serving_fleet", "max_qps"),
+    )
+    put(
+        "serving_route_p99_ms",
+        _dig(snapshot, "serving_fleet", "rebase", "p99_ms"),
+    )
     # streaming sparse state at scale: reshard throughput, the
     # windowed-vs-one-shot RSS ratio, and the delta-checkpoint stall
     # win at a table 4x its spill DRAM budget
@@ -2904,7 +3078,17 @@ def _headline(snapshot: dict) -> dict:
         k[: -len("_error")] for k in snapshot if k.endswith("_error")
     )
     if errors:
-        h["errors"] = errors
+        # byte diet: an everything-errored run must not spend the
+        # whole budget enumerating section names — the stderr detail
+        # line carries the full list and the messages.  The cap is
+        # display-only; the skipped/partial dedup below still keys on
+        # the FULL error set
+        if len(errors) > 12:
+            h["errors"] = errors[:12] + [
+                f"+{len(errors) - 12} more"
+            ]
+        else:
+            h["errors"] = errors
     notes = sorted(
         k[: -len("_note")]
         for k in snapshot
@@ -3153,6 +3337,15 @@ def main() -> int:
             _emit(results, partial=True)
         except Exception as e:  # noqa: BLE001
             results["serving_error"] = f"{type(e).__name__}: {e}"
+        # serving fleet: real router + replica subprocesses under
+        # synthetic routed load — tens of seconds, pure-host
+        try:
+            bench_serving_fleet(results, workdir)
+            _emit(results, partial=True)
+        except Exception as e:  # noqa: BLE001
+            results["serving_fleet_error"] = (
+                f"{type(e).__name__}: {e}"
+            )
         # sparse scale: pure-host numpy + native table work, tens of
         # seconds — the streaming-reshard and delta-checkpoint
         # headline numbers at a table ≥ 4x the spill DRAM budget

@@ -104,6 +104,59 @@ def record_throughput_snapshot(
     )
 
 
+def record_serving_fleet_snapshot(
+    store, job_name: str, snapshot: Dict,
+    timestamp: Optional[float] = None,
+) -> None:
+    """Persist one routed-QPS/freshness window from the serving-fleet
+    lookup router (:meth:`LookupRouter.stats_snapshot`) — the serving
+    analog of :func:`record_throughput_snapshot`: QPS grouped by pool
+    size is the raw material a ``ResizeCoordinator``-style optimizer
+    needs to grow/shrink the replica pool."""
+    store.persist(
+        JobMetricRecord(
+            job_name=job_name,
+            timestamp=timestamp or time.time(),
+            workers=int(snapshot.get("members_up", 0)),
+            samples_per_sec=float(snapshot.get("qps", 0.0)),
+            finished=False,
+        ),
+        event="serving_fleet_snapshot",
+        routed=int(snapshot.get("count", 0)),
+        failed=int(snapshot.get("failed", 0)),
+        stale=int(snapshot.get("stale", 0)),
+        rerouted=int(snapshot.get("rerouted", 0)),
+        p99_ms=snapshot.get("p99_ms"),
+        generation_floor=int(snapshot.get("generation_floor", -1)),
+        members_draining=int(snapshot.get("members_draining", 0)),
+        members_suspect=int(snapshot.get("members_suspect", 0)),
+    )
+
+
+def suggest_serving_pool_size(
+    snapshot: Dict,
+    qps_per_replica: float,
+    min_size: int = 1,
+    max_size: int = 8,
+    headroom: float = 1.25,
+) -> int:
+    """Pool-size recommendation from one router snapshot: enough
+    healthy replicas to carry the observed routed QPS at
+    ``qps_per_replica`` with ``headroom``, never below what drain
+    safety needs (one member must always be able to re-base while the
+    rest carry traffic)."""
+    qps = float(snapshot.get("qps", 0.0))
+    need = qps * headroom / max(1e-9, qps_per_replica)
+    size = max(min_size, int(need) + (need > int(need)))
+    # a pool carrying traffic needs a spare member so one can drain
+    # for a re-base while the rest keep serving
+    if (
+        snapshot.get("members_draining", 0) or (qps > 0 and size == 1)
+    ) and max_size >= 2:
+        size = max(size, 2)
+    return min(max_size, size)
+
+
 def ingest_job_events(
     store, job_name: str, sources: Iterable[str]
 ) -> Optional[Dict]:

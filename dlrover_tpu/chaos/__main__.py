@@ -112,6 +112,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             total_steps=args.steps,
             max_restarts=args.max_restarts,
         )
+    elif scenario.name == "serving-fleet-replica-kill":
+        # needs the fleet runner: an in-process publisher, a
+        # supervised router subprocess and a replica pool under
+        # synthetic routed load
+        report = harness.run_serving_fleet_scenario(
+            scenario, workdir=workdir,
+        )
     elif scenario.name in (
         "serving-replica-kill-midingest",
         "serving-trainer-kill-midpublish",

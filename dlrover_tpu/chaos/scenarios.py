@@ -1891,6 +1891,53 @@ def serving_replica_kill_midingest(seed: int = 83) -> Scenario:
     })
 
 
+def serving_fleet_replica_kill(seed: int = 97) -> Scenario:
+    """Serving-fleet routing under fire (ISSUE 17): against a live
+    replica POOL fronted by the lookup router, SIGKILL (a) replica 0
+    INSIDE a generation apply (``serving.ingest``, env-pinned to
+    ``DLROVER_SERVING_REPLICA_ID=0`` — role alone would kill every
+    member) and (b) the ROUTER itself mid-stream (``serving.route``
+    fires once per routed lookup).  The router must shed the dead
+    replica within the heartbeat window and keep answering from the
+    survivors — zero failed and zero stale lookups counted on the
+    ``serving_route`` windows — and the respawned router must replay
+    its journaled membership to the identical routing table and
+    resume routing without restarting any healthy replica.  The
+    ``DLROVER_SERVING_RESPAWNED`` guards keep both kills
+    single-shot."""
+    return Scenario.from_dict({
+        "name": "serving-fleet-replica-kill",
+        "seed": seed,
+        "rules": [{
+            "name": "kill-pool-replica-midingest",
+            "point": "serving.ingest",
+            "action": "kill",
+            "after_calls": 3,
+            "max_count": 1,
+            "env_equals": {
+                "DLROVER_SERVING_ROLE": "replica",
+                "DLROVER_SERVING_REPLICA_ID": "0",
+                "DLROVER_SERVING_RESPAWNED": "",
+            },
+        }, {
+            # time-based, NOT call-count: the router kill must land
+            # AFTER the killed replica has been shed and its respawn
+            # re-admitted (simultaneous kills would leave no router
+            # alive to witness the shed), and the route hook fires
+            # continuously under load so the window is hit exactly
+            "name": "kill-router-midroute",
+            "point": "serving.route",
+            "action": "kill",
+            "after_time": 5.0,
+            "max_count": 1,
+            "env_equals": {
+                "DLROVER_SERVING_ROLE": "router",
+                "DLROVER_SERVING_RESPAWNED": "",
+            },
+        }],
+    })
+
+
 def serving_trainer_kill_midpublish(seed: int = 89) -> Scenario:
     """Serving-plane publisher exactly-once (ISSUE 13): SIGKILL the
     trainer between writing a generation's blobs/manifest and its
@@ -1999,6 +2046,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "sparse_resize_churn": sparse_resize_churn,
     "sparse_streaming_reshard_kill": sparse_streaming_reshard_kill,
     "serving_replica_kill_midingest": serving_replica_kill_midingest,
+    "serving_fleet_replica_kill": serving_fleet_replica_kill,
     "serving_trainer_kill_midpublish": (
         serving_trainer_kill_midpublish
     ),
@@ -2139,6 +2187,21 @@ RUN_OPTIONS: Dict[str, Dict] = {
             # while the replica is alive on a loaded CI box
             "DLROVER_CHAOS_STEP_SLEEP": "0.2",
         },
+    },
+    # serving fleet: no trainer subprocess at all — the fleet runner
+    # (run_serving_fleet_scenario) publishes in-process and drives
+    # real routed load; these knobs shape the run.  compact_every=3
+    # forces base generations (= drained re-bases) to land mid-load;
+    # the 2 ms lookup floor models the TPU device-gather a CPU-only
+    # CI box cannot reproduce, so in-flight requests genuinely
+    # overlap across the pool
+    "serving-fleet-replica-kill": {
+        "pool_size": 3,
+        "generations": 10,
+        "publish_every_s": 0.35,
+        "compact_every": 3,
+        "load_streams": 4,
+        "lookup_floor_ms": 2.0,
     },
     # ckpt_every=4 vs publish-every-2: the kill (3rd publish = step
     # 6) restores the step-4 snapshot and REPLAYS steps 5-6, so the

@@ -24,6 +24,7 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.telemetry import metrics as _metrics
 from dlrover_tpu.telemetry.events import emit_event
 from dlrover_tpu.telemetry.slo import (
+    HistogramWindow,
     SloRule,
     estimate_quantile,
     rules_from_env,
@@ -57,51 +58,10 @@ def _gauge_map(registry, name: str) -> Dict[str, float]:
     return out
 
 
-class _VerbWindow:
-    """Delta tracker for one histogram: previous cumulative bucket
-    counts per label set, yielding per-window counts on demand."""
-
-    def __init__(self):
-        self._prev: Dict[Tuple, Tuple[List[int], float]] = {}
-
-    def deltas(self, collected) -> Dict[Tuple, Dict]:
-        """{label_key: {bounds, counts, count, sum_s}} of everything
-        observed since the previous call."""
-        out: Dict[Tuple, Dict] = {}
-        seen = set()
-        for labels, snap in collected:
-            key = tuple(sorted(labels.items()))
-            seen.add(key)
-            counts = list(snap["bucket_counts"])
-            total = float(snap["sum"])
-            prev_counts, prev_sum = self._prev.get(
-                key, ([0] * len(counts), 0.0)
-            )
-            if len(prev_counts) != len(counts):
-                prev_counts = [0] * len(counts)
-                prev_sum = 0.0
-            d_counts = [
-                max(0, c - p) for c, p in zip(counts, prev_counts)
-            ]
-            out[key] = {
-                "labels": dict(labels),
-                "bounds": list(snap["bounds"]),
-                "counts": d_counts,
-                "count": sum(d_counts),
-                "sum_s": max(0.0, total - prev_sum),
-            }
-            self._prev[key] = (counts, total)
-        # label sets that vanished (registry reset) drop silently
-        for key in list(self._prev):
-            if key not in seen:
-                del self._prev[key]
-        return out
-
-    def reset(self, collected):
-        """Re-baseline without producing a window (level changes in
-        the capacity search must not mix two agent counts into one
-        window)."""
-        self.deltas(collected)
+# windowed-delta tracking moved to telemetry.slo.HistogramWindow so
+# the serving replica/router stats share the exact implementation;
+# the old private name stays as an alias for in-tree callers
+_VerbWindow = HistogramWindow
 
 
 class Scoreboard:

@@ -37,6 +37,17 @@ checkpoint PRs built:
   traffic, emitting ``serving_publish`` / ``serving_ingest`` /
   ``serving_freshness`` / ``serving_lookup_stats`` events plus the
   ``dlrover_serving_*`` metrics the bench and chaos invariants read.
+
+- The **serving fleet** (ROADMAP item 4's routing tier):
+  :class:`~dlrover_tpu.serving.pool.ReplicaPool` supervises N replica
+  processes over one publisher directory, and
+  :class:`~dlrover_tpu.serving.router.LookupRouter`
+  (``python -m dlrover_tpu.serving.router``) fronts them — journaled
+  membership/drain records (a router respawn replays to the same
+  routing table), splitmix64 HRW key-consistent routing with
+  least-loaded fallback and optional hedging, the drain protocol that
+  makes base re-bases invisible to traffic, and a routed-QPS/
+  freshness feed into the Brain datastore for pool sizing.
 """
 
 from dlrover_tpu.serving.publisher import (
@@ -48,7 +59,24 @@ from dlrover_tpu.serving.replica import ServingReplica
 
 __all__ = [
     "EmbeddingPublisher",
+    "LookupRouter",
+    "ReplicaPool",
+    "RoutingTable",
     "SERVING_TRACKER",
     "ServingReplica",
     "committed_generation",
 ]
+
+
+def __getattr__(name):
+    # router/pool import the comm + journal stacks; lazy so plain
+    # publisher/replica users never pay for them
+    if name in ("LookupRouter", "RoutingTable"):
+        from dlrover_tpu.serving import router
+
+        return getattr(router, name)
+    if name == "ReplicaPool":
+        from dlrover_tpu.serving.pool import ReplicaPool
+
+        return ReplicaPool
+    raise AttributeError(name)

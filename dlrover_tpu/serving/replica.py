@@ -159,6 +159,12 @@ class ServingReplica:
         self.respawned = (
             os.environ.get("DLROVER_SERVING_RESPAWNED", "") != ""
         )
+        # serving-fleet drain protocol: called with the base
+        # generation about to be applied, BEFORE any staging work; a
+        # False return defers the whole catch-up pass (the router
+        # denied the drain — another member is re-basing), and the
+        # next poll retries.  Standalone replicas leave it None.
+        self.pre_base_hook = None
         self._swap_lock = threading.Lock()
         # serializes whole catch-up passes: two threads polling at
         # once (e.g. the replica process's poller plus a warm-up
@@ -415,6 +421,14 @@ class ServingReplica:
                         "missing/unreadable"
                     )
                 if manifest.get("kind", "base") == "base":
+                    if (
+                        self.pre_base_hook is not None
+                        and self.generation > 0
+                        and not self.pre_base_hook(gen)
+                    ):
+                        # drain denied: keep serving the current
+                        # generation, retry the re-base next poll
+                        break
                     # bases stream windowed into staging tables —
                     # the swap lock is held O(1), replica RSS never
                     # spikes by the decoded base size

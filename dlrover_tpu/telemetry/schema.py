@@ -171,9 +171,31 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         # periodic lookup-traffic sample from the replica process:
         # latency percentiles + throughput under (possibly) live
         # ingest, tagged with the served generation
+        # ``replica`` is the reporting side: a pool member's id, or
+        # "load" for the fleet harness's client-side aggregate (which
+        # adds ``failed``/``streams`` — the zero-client-visible-
+        # failure half of the fleet chaos verdict)
         _s("serving_lookup_stats",
            ["count", "p50_ms", "p99_ms", "qps", "window_s"],
-           ["rows", "generation"]),
+           ["rows", "generation", "replica", "failed", "streams"]),
+        # -- serving fleet (replica pool + lookup router) ------------
+        # one routed-traffic window from the lookup router: outcome
+        # counts (ok / rerouted / stale / failed — the zero-failure
+        # and zero-stale invariants count these), shared-estimator
+        # p50/p99 over the window's bucket deltas, pool composition
+        # and the newest admitted generation (the freshness floor)
+        _s("serving_route",
+           ["count", "qps", "window_s", "generation_floor", "ok",
+            "rerouted", "stale", "failed", "members_up"],
+           ["p50_ms", "p99_ms", "members_draining",
+            "members_suspect", "hedged"]),
+        # routing-table state transition for one pool member: state =
+        # joined / admitted / draining / suspect / lost / recovered /
+        # removed; emitted on CHANGE only (heartbeats are silent), so
+        # shed/admit latency and membership history read from the log
+        _s("replica_status",
+           ["replica_id", "generation", "state"],
+           ["addr", "draining", "respawned", "target_generation"]),
         # -- agent ---------------------------------------------------
         # reason: failure / membership / hang / resize — what drove
         # this restart (resize restarts are planned drains)

@@ -117,6 +117,73 @@ def estimate_quantile(
     return lower
 
 
+class HistogramWindow:
+    """Windowed-delta tracker over a cumulative histogram: remembers
+    the previous cumulative bucket counts per label set and yields
+    per-window (non-cumulative) counts on demand.  THE shared
+    windowing primitive — the fleet Scoreboard, the serving replica's
+    lookup stats, and the lookup router's route stats all window the
+    same way, and :func:`estimate_quantile` reads the windows, so
+    every p50/p99 in the system is one implementation."""
+
+    def __init__(self):
+        self._prev: Dict[Tuple, Tuple[List[int], float]] = {}
+
+    def deltas(self, collected) -> Dict[Tuple, Dict]:
+        """{label_key: {labels, bounds, counts, count, sum_s}} of
+        everything observed since the previous call."""
+        out: Dict[Tuple, Dict] = {}
+        seen = set()
+        for labels, snap in collected:
+            key = tuple(sorted(labels.items()))
+            seen.add(key)
+            counts = list(snap["bucket_counts"])
+            total = float(snap["sum"])
+            prev_counts, prev_sum = self._prev.get(
+                key, ([0] * len(counts), 0.0)
+            )
+            if len(prev_counts) != len(counts):
+                prev_counts = [0] * len(counts)
+                prev_sum = 0.0
+            d_counts = [
+                max(0, c - p) for c, p in zip(counts, prev_counts)
+            ]
+            out[key] = {
+                "labels": dict(labels),
+                "bounds": list(snap["bounds"]),
+                "counts": d_counts,
+                "count": sum(d_counts),
+                "sum_s": max(0.0, total - prev_sum),
+            }
+            self._prev[key] = (counts, total)
+        # label sets that vanished (registry reset) drop silently
+        for key in list(self._prev):
+            if key not in seen:
+                del self._prev[key]
+        return out
+
+    def reset(self, collected):
+        """Re-baseline without producing a window (a config change
+        mid-run must not mix two regimes into one window)."""
+        self.deltas(collected)
+
+
+def window_quantiles_ms(
+    window: Dict, qs: Sequence[float] = (0.5, 0.99)
+) -> Dict[str, float]:
+    """``{"p50_ms": ..., "p99_ms": ...}`` from one
+    :meth:`HistogramWindow.deltas` entry — the event-facing shape the
+    serving stats emitters share."""
+    return {
+        f"p{q * 100:g}_ms": round(
+            estimate_quantile(window["bounds"], window["counts"], q)
+            * 1e3,
+            4,
+        )
+        for q in qs
+    }
+
+
 @dataclass
 class SloBreach:
     verb: str

@@ -188,8 +188,28 @@ def assemble(events: Iterable[Dict]) -> JobTimeline:
                      "diagnosis_verdict", "hang_evidence",
                      "rpc_slo_breach", "compile_cache", "aot_cache",
                      "fleet_report", "fleet_capacity",
-                     "serving_freshness", "serving_lookup_stats"):
+                     "serving_freshness", "serving_lookup_stats",
+                     "replica_status"):
             tl.instants.append(e)
+            continue
+        if etype == "serving_route":
+            # one routed-traffic window on the serving fleet track:
+            # the router emits at window END with the window length
+            win = _num(e.get("window_s"))
+            tl.slices.append(Slice(
+                name=(
+                    f"route window {e.get('count')} lookups "
+                    f"gen>={e.get('generation_floor')}"
+                ),
+                cat=CAT_SERVING,
+                start=ts - win, end=ts, track="serving fleet",
+                meta={k: e.get(k) for k in (
+                    "count", "qps", "p50_ms", "p99_ms", "ok",
+                    "rerouted", "stale", "failed", "members_up",
+                    "members_draining", "members_suspect",
+                    "generation_floor", "hedged",
+                ) if e.get(k) is not None},
+            ))
             continue
         if etype in ("serving_publish", "serving_ingest"):
             secs = _num(e.get("seconds"))
@@ -848,6 +868,12 @@ def _describe_instant(e: Dict) -> str:
             f"p99={_num(e.get('p99_ms')):.2f}ms "
             f"@ {_num(e.get('qps')):.0f} batch/s "
             f"gen {e.get('generation')}"
+        )
+    if etype == "replica_status":
+        return (
+            f"replica {e.get('replica_id')} "
+            f"{e.get('state')} gen {e.get('generation')}"
+            + (" (respawned)" if e.get("respawned") else "")
         )
     if etype == "fleet_report":
         return (

@@ -59,7 +59,8 @@ def pytest_collection_modifyitems(config, items):
         "test_chaos.py",
         "test_restore_pipeline.py", "test_master_journal.py",
         "test_resize.py", "test_sparse_checkpoint.py",
-        "test_serving.py", "test_streaming_sparse.py",
+        "test_serving.py", "test_serving_router.py",
+        "test_streaming_sparse.py",
         "test_recovery.py", "test_aot_cache.py",
         "test_slo.py", "test_fleet.py", "test_rl_elastic.py",
         # the chaos acceptance e2e runs (worker kill, shm fallback,

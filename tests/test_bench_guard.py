@@ -78,6 +78,14 @@ def _fat_snapshot() -> dict:
             "full_export_s": 0.345678,
             "delta_export_s": 0.008123,
         },
+        "serving_fleet": {
+            "max_qps": 1234.512345,
+            "scaling_1_to_2_x": 1.812345,
+            "rebase": {
+                "p99_ms": 12.345678, "failed": 0,
+                "p99_over_quiet_x": 1.512345,
+            },
+        },
         "sparse_scale": {
             "table_rows": 150000,
             "table_mb": 38.912345,
@@ -123,7 +131,8 @@ def _fat_snapshot() -> dict:
         "goodput", "llama_train_step", "train_step", "xl_train_step",
         "xl_act_offload", "flash_ckpt", "auto_config", "sparse_kv",
         "input_pipeline", "gqa_attention_kernel", "attention_kernel",
-        "elastic_recovery", "serving", "sparse_scale", "multislice",
+        "elastic_recovery", "serving", "serving_fleet",
+        "sparse_scale", "multislice",
         "sequence_parallel", "rl_elastic",
     ]
     for name in sections:

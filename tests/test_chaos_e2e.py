@@ -742,6 +742,56 @@ def test_serving_trainer_kill_midpublish(tmp_path):
     assert serving_slices, "no serving slices on the timeline"
 
 
+def test_serving_fleet_replica_kill(tmp_path):
+    """ISSUE 17 acceptance (tier-1): under live routed traffic
+    against a 3-replica pool, SIGKILL replica 0 mid-ingest AND the
+    lookup router mid-stream.  The router sheds the dead member
+    within the heartbeat window and keeps answering from survivors —
+    zero failed and zero stale lookups on the serving_route windows,
+    zero client-visible failures in the load aggregate — the
+    respawned router replays its journaled membership to the
+    identical live routing table without restarting healthy
+    replicas, and the freshness floor never regresses."""
+    report = harness.run_serving_fleet_scenario(
+        scenarios.serving_fleet_replica_kill(seed=97),
+        workdir=str(tmp_path / "run"),
+    )
+    assert report.ok, report.summary()
+    # both seeded kills fired: the replica's ingest hook and the
+    # router's route hook
+    points = {t[1] for t in report.timeline}
+    assert points == {"serving.ingest", "serving.route"}, (
+        report.timeline
+    )
+    # routed windows exist on both sides of the router kill (the
+    # respawn resumed emitting), and the fleet's stats windows landed
+    # on the assembled timeline's "serving fleet" track
+    router_kill_ts = min(
+        e["ts"] for e in report.events
+        if e.get("type") == "chaos_inject"
+        and e.get("point") == "serving.route"
+    )
+    windows = [
+        e for e in report.events if e.get("type") == "serving_route"
+    ]
+    assert any(e["ts"] < router_kill_ts for e in windows)
+    assert any(e["ts"] > router_kill_ts for e in windows)
+    assert report.job_timeline is not None
+    fleet_slices = [
+        s for s in report.job_timeline.slices
+        if s.track == "serving fleet"
+    ]
+    assert fleet_slices, "no serving-fleet slices on the timeline"
+    # the load harness's client-side aggregate is in the event log
+    # (the zero-client-visible-failure half of the verdict)
+    loads = [
+        e for e in report.events
+        if e.get("type") == "serving_lookup_stats"
+        and e.get("replica") == "load"
+    ]
+    assert loads and loads[0]["failed"] == 0, loads
+
+
 def test_rl_rollout_worker_kill(tmp_path):
     """ISSUE 16 acceptance (tier-1): SIGKILL the PPO rollout worker
     mid-iteration — on lease 2's ``rl.rollout`` hook, after the

@@ -1,0 +1,408 @@
+"""Serving-fleet router unit/integration tests (ISSUE 17).
+
+The determinism contract carries this file: the routing table a
+respawned router REPLAYS from its journal must equal the live one it
+lost, and key-consistent HRW routing must move ONLY the keys whose
+owner changed when the pool grows or shrinks.  The process-level
+version of both (SIGKILL under live routed load) lives in
+``test_chaos_e2e.py::test_serving_fleet_replica_kill``; here the same
+properties are pinned fast and in-process.
+"""
+
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common.comm import MessageClient, MessageServer
+from dlrover_tpu.serving.messages import (
+    DrainRequest,
+    LookupRequest,
+    LookupResponse,
+    ReplicaStatus,
+)
+from dlrover_tpu.serving.router import (
+    LookupRouter,
+    RoutingTable,
+    hrw_owner,
+    mix64,
+)
+
+
+def test_hrw_only_moved_keys_reroute():
+    """The elasticity contract: growing the pool re-routes ONLY keys
+    whose argmax lands on the new member; shrinking re-routes ONLY
+    the removed member's keys.  Placement is also roughly balanced
+    (HRW over the splitmix64 finalizer, not a modulo)."""
+    keys = list(range(2000))
+    before = {k: hrw_owner(k, [0, 1, 2]) for k in keys}
+
+    grown = {k: hrw_owner(k, [0, 1, 2, 3]) for k in keys}
+    moved = [k for k in keys if grown[k] != before[k]]
+    assert moved, "growing a pool must claim some keys"
+    assert all(grown[k] == 3 for k in moved)
+    # ~1/4 of the keyspace, not a full reshuffle
+    assert len(moved) < len(keys) / 2
+
+    shrunk = {k: hrw_owner(k, [0, 2]) for k in keys}
+    for k in keys:
+        if before[k] != 1:
+            assert shrunk[k] == before[k], k
+        else:
+            assert shrunk[k] in (0, 2)
+
+    counts = {}
+    for k in keys:
+        counts[before[k]] = counts.get(before[k], 0) + 1
+    assert min(counts.values()) > len(keys) / 6, counts
+
+
+def test_mix64_matches_vectorized_hash():
+    """The scalar finalizer equals ``checkpoint.sparse._hash64`` —
+    every plane partitions keys identically."""
+    from dlrover_tpu.checkpoint.sparse import _hash64
+
+    keys = np.array([0, 1, 7, 12345, 2**63 - 1], dtype=np.int64)
+    vec = _hash64(keys)
+    for k, h in zip(keys.tolist(), vec.tolist()):
+        assert mix64(k) == h & 0xFFFFFFFFFFFFFFFF
+
+
+def test_routing_table_replay_determinism(tmp_path):
+    """Cold journal replay == live table after an arbitrary record
+    sequence, and again after close() compacts it into a snapshot."""
+    jdir = str(tmp_path / "journal")
+    live = RoutingTable(jdir)
+    live.record("member", {"replica_id": 0, "addr": "a:1",
+                           "generation": 1})
+    live.record("member", {"replica_id": 1, "addr": "b:2",
+                           "generation": 1})
+    live.record("admit", {"replica_id": 0, "generation": 3})
+    live.record("drain", {"replica_id": 1, "target_generation": 4})
+    live.record("admit", {"replica_id": 1, "generation": 4})
+    live.record("member", {"replica_id": 2, "addr": "c:3",
+                           "generation": 4})
+    live.record("remove", {"replica_id": 2})
+
+    replayed = RoutingTable.replayed(jdir)
+    assert replayed.snapshot() == live.snapshot()
+    assert replayed.generation_floor == 4
+    assert replayed.members[1].draining is False
+    assert replayed.members[2].removed is True
+
+    # admitted generations are monotonic: a regression is not applied
+    live.record("admit", {"replica_id": 0, "generation": 2})
+    assert live.members[0].generation == 3
+    snap_before = live.snapshot()
+    live.close()  # writes the final snapshot
+    assert RoutingTable.replayed(jdir).snapshot() == snap_before
+
+    # a new journal handle over the compacted dir sees the same table
+    reopened = RoutingTable(jdir)
+    try:
+        assert reopened.snapshot() == snap_before
+    finally:
+        reopened.close()
+
+
+class _FakeReplica:
+    """Minimal replica: a real MessageServer answering lookups with a
+    fixed generation, with an optional service delay."""
+
+    def __init__(self, replica_id: int, generation: int,
+                 delay_s: float = 0.0):
+        self.replica_id = replica_id
+        self.generation = generation
+        self.delay_s = delay_s
+        self.fail = False
+        self.served = 0
+        self.server = MessageServer(0, self)
+        self.server.start()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.server.port}"
+
+    def status(self, **kw) -> ReplicaStatus:
+        return ReplicaStatus(
+            replica_id=self.replica_id, addr=self.addr,
+            generation=self.generation, **kw,
+        )
+
+    def report(self, node_id, node_type, message) -> bool:
+        return True
+
+    def get(self, node_id, node_type, message):
+        if isinstance(message, LookupRequest):
+            if self.fail:
+                raise RuntimeError("replica is down")
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            self.served += 1
+            return LookupResponse(
+                values=np.zeros((1, 2), dtype=np.float32),
+                generation=self.generation,
+                replica_id=self.replica_id,
+            )
+        return None
+
+    def stop(self):
+        self.server.stop()
+
+
+@pytest.fixture
+def router(tmp_path):
+    r = LookupRouter(
+        journal_dir=str(tmp_path / "journal"),
+        heartbeat_timeout_s=30.0,  # liveness via explicit tests only
+        stats_every_s=30.0,
+        min_available=1,
+    )
+    yield r
+    r.stop()
+
+
+def test_route_owner_fallback_and_suspect(router):
+    """Forward failure sheds the owner in-line: the caller sees
+    outcome ``rerouted``, never an error, and the dead member is
+    marked suspect (excluded from the next route)."""
+    a = _FakeReplica(0, generation=5)
+    b = _FakeReplica(1, generation=5)
+    try:
+        router.on_status(a.status())
+        router.on_status(b.status())
+        # a shard key owned by replica 0
+        key = next(
+            k for k in range(1000) if hrw_owner(k, [0, 1]) == 0
+        )
+        resp = router.route(LookupRequest(shard_key=key))
+        assert resp.outcome == "ok" and resp.replica_id == 0
+
+        # the owner starts failing its forwards (stop() alone would
+        # leave the router's pooled connection alive and served)
+        a.fail = True
+        resp = router.route(LookupRequest(shard_key=key))
+        assert resp.outcome == "rerouted" and resp.replica_id == 1
+        assert router.table.members[0].suspect
+        # suspect member is no longer a candidate
+        resp = router.route(LookupRequest(shard_key=key))
+        assert resp.outcome == "ok" and resp.replica_id == 1
+        # the next heartbeat recovers it
+        a.fail = False
+        router.on_status(a.status())
+        assert not router.table.members[0].suspect
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_drain_protocol_grant_deny_readmit(router):
+    """min_available gates concurrent drains (re-bases serialize);
+    re-admission arrives with the next status report carrying the
+    drained-for generation and advances the freshness floor."""
+    a = _FakeReplica(0, generation=3)
+    b = _FakeReplica(1, generation=3)
+    try:
+        router.on_status(a.status())
+        router.on_status(b.status())
+        grant = router.on_drain(
+            DrainRequest(replica_id=0, target_generation=4)
+        )
+        assert grant.granted
+        # second concurrent drain would empty the pool: denied
+        deny = router.on_drain(
+            DrainRequest(replica_id=1, target_generation=4)
+        )
+        assert not deny.granted and "min_available" in deny.reason
+        # draining member is not routable
+        key = next(
+            k for k in range(1000) if hrw_owner(k, [0, 1]) == 0
+        )
+        resp = router.route(LookupRequest(shard_key=key))
+        assert resp.replica_id == 1 and resp.outcome == "ok"
+        # re-admission at the new base generation
+        a.generation = 4
+        router.on_status(a.status())
+        m = router.table.members[0]
+        assert not m.draining and m.generation == 4
+        assert router.table.generation_floor == 4
+        # now the OTHER member may drain
+        assert router.on_drain(
+            DrainRequest(replica_id=1, target_generation=4)
+        ).granted
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_hedged_forward_takes_first_answer(tmp_path):
+    """With ``hedge_ms`` armed, a straggling owner gets a backup
+    request on another member and the first response wins."""
+    router = LookupRouter(
+        journal_dir=str(tmp_path / "journal"),
+        heartbeat_timeout_s=30.0, stats_every_s=30.0,
+        hedge_ms=20.0,
+    )
+    slow = _FakeReplica(0, generation=2, delay_s=0.4)
+    fast = _FakeReplica(1, generation=2)
+    try:
+        router.on_status(slow.status())
+        router.on_status(fast.status())
+        key = next(
+            k for k in range(1000) if hrw_owner(k, [0, 1]) == 0
+        )
+        t0 = time.perf_counter()
+        resp = router.route(LookupRequest(shard_key=key))
+        dt = time.perf_counter() - t0
+        assert resp.replica_id == 1, "backup's answer must win"
+        assert dt < 0.4, f"hedge did not cut the straggle: {dt:.3f}s"
+        assert router._hedged >= 1
+    finally:
+        router.stop()
+        slow.stop()
+        fast.stop()
+
+
+def test_router_restart_replays_membership(tmp_path):
+    """An in-process router restart over the same journal dir comes
+    back with the identical table — the unit-level version of the
+    chaos scenario's kill/respawn determinism check."""
+    jdir = str(tmp_path / "journal")
+    r1 = LookupRouter(journal_dir=jdir, heartbeat_timeout_s=30.0,
+                      stats_every_s=30.0)
+    a = _FakeReplica(0, generation=7)
+    b = _FakeReplica(1, generation=7)
+    try:
+        r1.on_status(a.status())
+        r1.on_status(b.status())
+        r1.on_drain(DrainRequest(replica_id=0, target_generation=8))
+        want = r1.table.snapshot()
+        r1.stop()
+
+        r2 = LookupRouter(journal_dir=jdir, heartbeat_timeout_s=30.0,
+                          stats_every_s=30.0)
+        try:
+            assert r2.table.snapshot() == want
+            assert r2.table.members[0].draining
+            # routing works immediately from the replayed table
+            resp = r2.route(LookupRequest(shard_key=1))
+            assert resp.replica_id == 1 and resp.outcome == "ok"
+        finally:
+            r2.stop()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_route_over_real_transport_and_stats(router):
+    """Lookups through a real MessageClient land in the stats
+    snapshot with the shared bucket-interpolated quantiles."""
+    a = _FakeReplica(0, generation=8)
+    router.on_status(a.status())
+    # the freshness floor rises only on an admitted generation
+    # ADVANCE (the join's base generation is not an admission)
+    a.generation = 9
+    router.on_status(a.status())
+    # the route histogram lives in the process-global metrics
+    # registry: baseline the window so routes from other tests in
+    # this process don't land in our first delta
+    router.stats_snapshot(window_s=0.1)
+    client = MessageClient(
+        f"127.0.0.1:{router.port}", node_id=0,
+        node_type="test-load", timeout=10.0, retries=2,
+        backoff_base=0.05, backoff_max=0.1, resync_timeout=0.0,
+    )
+    try:
+        for k in range(20):
+            resp = client.get(LookupRequest(
+                keys=np.arange(4, dtype=np.int64), shard_key=k,
+            ))
+            assert resp.outcome == "ok" and resp.generation == 9
+        snap = router.stats_snapshot(window_s=1.0)
+        assert snap["count"] == 20 and snap["ok"] == 20
+        assert snap["failed"] == 0 and snap["stale"] == 0
+        assert snap["p99_ms"] >= snap["p50_ms"] > 0
+        assert snap["generation_floor"] == 9
+        assert snap["members_up"] == 1
+    finally:
+        client.close()
+        a.stop()
+
+
+def test_shared_quantile_estimator_is_single_implementation():
+    """Satellite 2: one quantile implementation.  The scoreboard's
+    per-verb window IS the telemetry HistogramWindow, and the replica
+    / router percentiles come from the same bucket-interpolated
+    estimator."""
+    from dlrover_tpu.fleet.scoreboard import _VerbWindow
+    from dlrover_tpu.telemetry.slo import (
+        HistogramWindow,
+        window_quantiles_ms,
+    )
+    from dlrover_tpu.telemetry.metrics import MetricsRegistry
+
+    assert _VerbWindow is HistogramWindow
+
+    reg = MetricsRegistry()
+    hist = reg.histogram(
+        "t_seconds", "t", buckets=(0.001, 0.01, 0.1, 1.0)
+    )
+    for v in (0.002, 0.003, 0.02, 0.05, 0.5):
+        hist.observe(v)
+    window = HistogramWindow()
+    entry = next(iter(window.deltas(hist.collect()).values()))
+    assert entry["count"] == 5
+    q = window_quantiles_ms(entry)
+    assert 1.0 <= q["p50_ms"] <= 100.0
+    assert q["p99_ms"] >= q["p50_ms"]
+    # windowed-delta semantics: a drained window reports nothing new
+    again = next(iter(window.deltas(hist.collect()).values()))
+    assert again["count"] == 0
+
+
+def test_replica_prom_files_aggregate_into_master_metrics(tmp_path):
+    """Satellite 1: per-replica textfile dumps (the pool's
+    ``replica*.prom``) fold into the master's ``/metrics`` via
+    ``DLROVER_METRICS_AGGREGATE_GLOB``, each sample tagged with its
+    replica's file stem so same-named series never collide."""
+    from dlrover_tpu.telemetry.exporter import (
+        PrometheusEndpoint,
+        aggregate_textfiles,
+    )
+    from dlrover_tpu.telemetry.metrics import MetricsRegistry
+
+    for rid, count in ((0, 11), (1, 7)):
+        with open(tmp_path / f"replica{rid}.prom", "w") as f:
+            f.write(
+                "# HELP dlrover_serving_lookup_seconds lookup\n"
+                "# TYPE dlrover_serving_lookup_seconds histogram\n"
+                "dlrover_serving_lookup_seconds_count "
+                f"{count}\n"
+                f"dlrover_serving_lookup_seconds_sum 0.{count}\n"
+            )
+    glob = str(tmp_path / "replica*.prom")
+    merged = aggregate_textfiles(glob)
+    assert 'agent="replica0"' in merged
+    assert 'agent="replica1"' in merged
+
+    endpoint = PrometheusEndpoint(
+        port=0, registry=MetricsRegistry(), aggregate_glob=glob
+    )
+    endpoint.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{endpoint.port}/metrics", timeout=10
+        ).read().decode()
+    finally:
+        endpoint.stop()
+    assert (
+        'dlrover_serving_lookup_seconds_count{agent="replica0"} 11'
+        in body
+    )
+    assert (
+        'dlrover_serving_lookup_seconds_count{agent="replica1"} 7'
+        in body
+    )
