@@ -294,6 +294,15 @@ DISK_EVERY = int(os.environ.get("DLROVER_CHAOS_DISK_EVERY", "3"))
 STEP_SLEEP = float(os.environ.get("DLROVER_CHAOS_STEP_SLEEP", "0"))
 SHARD_DATASET = int(os.environ.get("DLROVER_CHAOS_SHARD_DATASET", "0"))
 DIM = int(os.environ.get("DLROVER_CHAOS_RESIZE_DIM", "64"))
+# tail-stretch: while running below full strength (the shrunken
+# world between the kill and the grow-back), slow the step cadence so
+# the job cannot finish before the coordinator's grow-back decision
+# lands — the decision race, not the training math, is what the
+# churn scenario exercises
+NNODES = int(os.environ.get("DLROVER_CHAOS_NNODES", "0") or 0)
+SHRUNK_SLEEP = float(
+    os.environ.get("DLROVER_CHAOS_SHRUNK_STEP_SLEEP", "0") or 0
+)
 
 WORLD = int(os.environ.get("DLROVER_WORLD_SIZE", "1") or 1)
 RANK = int(os.environ.get("DLROVER_RANK", "0") or 0)
@@ -385,7 +394,9 @@ for k in range(start_step, TOTAL_STEPS):
     trainer.report_step({"loss": float(loss)})
     if task is not None:
         sc.report_task_done(task.task_id)
-    if STEP_SLEEP:
+    if NNODES and WORLD < NNODES and SHRUNK_SLEEP:
+        time.sleep(SHRUNK_SLEEP)
+    elif STEP_SLEEP:
         time.sleep(STEP_SLEEP)
     with trainer.profile("checkpoint"):
         if DISK_EVERY and trainer.global_step % DISK_EVERY == 0:
@@ -2122,6 +2133,13 @@ RUN_OPTIONS: Dict[str, Dict] = {
         "total_steps": 24,
         "disk_every": 3,
         "step_sleep": 0.3,
+        # while the world is shrunken the loop crawls: on a loaded
+        # box the replacement can take several seconds to boot, and
+        # at 0.3 s/step the survivor would otherwise finish all 24
+        # steps before the grow-back decision fires (flaky "never
+        # grew back" verdicts) — stretching only the shrunken tail
+        # bounds that race without slowing the healthy phases
+        "shrunk_step_sleep": 1.0,
         "shard_dataset": True,
         "extra_env": {
             "DLROVER_MONITOR_REPORT_INTERVAL": "0.5",

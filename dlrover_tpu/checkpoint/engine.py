@@ -68,6 +68,11 @@ _RESTORE_STAGE_SECONDS = _REG.histogram(
     "Per-stage restore pipeline time (labels: tier, stage = "
     "read / assemble / h2d)",
 )
+_SAVE_STAGE_SECONDS = _REG.histogram(
+    "dlrover_checkpoint_save_stage_seconds",
+    "Per-stage save pipeline time (labels: mode = flat / paged, "
+    "stage = fetch / compare / memcpy / kv / publish)",
+)
 
 
 class CheckpointEngine:
@@ -321,15 +326,30 @@ class CheckpointEngine:
         path, so waiting for the agent is free and the save must not
         be silently dropped."""
         self._notify_agent_to_create_saver()
+        from dlrover_tpu.checkpoint.shm_handler import paged_enabled
+
+        # paged hot saves (DLROVER_SHM_PAGED): write only what
+        # changed — dense leaves copy-skipped, sparse rows as delta
+        # pages via the shm dirty-consumer slot.  Sparse DURABLE
+        # saves stay flat: their delta chain belongs to the storage
+        # consumer and replays from committed step dirs, not shm.
+        use_paged = (
+            paged_enabled()
+            and not durable
+            and isinstance(state_dict, dict)
+            and KV_STATE_KEY not in state_dict
+        )
         # sparse tables export here on the SYNC path (MEMORY saves /
         # no-device-array states); the async path already merged a
         # consistent export before queueing, which the key guard skips
         merged_here = (
-            self._sparse is not None
+            not use_paged
+            and self._sparse is not None
             and isinstance(state_dict, dict)
             and KV_STATE_KEY not in state_dict
         )
-        state_dict = self._merge_sparse(state_dict, step, durable)
+        if not use_paged:
+            state_dict = self._merge_sparse(state_dict, step, durable)
         # every rank locks its shard: the agent's breakpoint save reads
         # all local shards, so an unlocked write can be torn even for
         # ranks that never persist to storage; without an agent there
@@ -363,13 +383,23 @@ class CheckpointEngine:
                 global_shard_num=self.global_shard_num,
             )
             start = time.time()
-            self._shm_handler.save_state_dict(state_dict, config)
+            if use_paged:
+                self._save_paged(step, state_dict, config)
+            else:
+                self._shm_handler.save_state_dict(state_dict, config)
             self._cached_step = step
             phases = dict(self._shm_handler.last_save_phases)
             phases["lock_wait_s"] = round(lock_wait, 3)
             phases["total_s"] = round(time.time() - start + lock_wait, 3)
             self.last_save_phases = phases
             _SHM_SAVE_SECONDS.observe(phases["total_s"])
+            mode = "paged" if phases.get("paged") else "flat"
+            for stage in ("fetch", "compare", "memcpy", "kv", "publish"):
+                sec = phases.get(f"{stage}_s")
+                if sec is not None:
+                    _SAVE_STAGE_SECONDS.observe(
+                        float(sec), mode=mode, stage=stage
+                    )
             emit_event(
                 "checkpoint_shm_save",
                 step=step,
@@ -387,6 +417,49 @@ class CheckpointEngine:
         finally:
             if locked:
                 self._shm_lock.release()
+
+    def _save_paged(self, step: int, state_dict, config) -> None:
+        """One paged hot save under the shm lock: export the sparse
+        delta on the shm consumer slot, hand it to the handler as a
+        delta page; when the handler cannot take a delta (fresh/
+        invalid epoch, arena overflow) poison the shm chain,
+        re-export a full base and retry once.  Any failure after the
+        delta drained its baseline also poisons — those rows must
+        ride the next base, not vanish."""
+        from dlrover_tpu.checkpoint.shm_handler import (
+            PagedNeedBase,
+            shm_full_every,
+        )
+
+        kv_payload = None
+        if self._sparse is not None:
+            kv_payload = self._sparse.export_for_shm(
+                step=step, rank=self._rank,
+                full_every=shm_full_every(),
+            )
+        try:
+            try:
+                self._shm_handler.save_state_dict_paged(
+                    state_dict, config, kv_payload=kv_payload
+                )
+                return
+            except PagedNeedBase as e:
+                logger.info(
+                    "paged save of step %s re-basing: %s", step, e
+                )
+                if self._sparse is not None:
+                    self._sparse.shm_chain_poison()
+                    kv_payload = self._sparse.export_for_shm(
+                        step=step, rank=self._rank,
+                        full_every=shm_full_every(),
+                    )
+                self._shm_handler.save_state_dict_paged(
+                    state_dict, config, kv_payload=kv_payload
+                )
+        except Exception:
+            if self._sparse is not None:
+                self._sparse.shm_chain_poison()
+            raise
 
     def _agent_lock_available(self) -> bool:
         """Whether an agent-side lock server exists for this shard

@@ -102,14 +102,14 @@ struct Table {
   // that converges to the full key space (~40-50 B/key of permanent
   // overhead on a multi-GB table).
   //
-  // PER-CONSUMER baselines: the serving publisher (consumer 0) and
-  // the delta flash checkpointer (consumer 1) drain their deltas on
-  // independent cadences — one shared set would let either plane
-  // silently clear rows out of the other's next delta.  Each
-  // consumer arms and clears only its own slot; mutations mark every
-  // armed slot.
-  static constexpr int kDirtyConsumers = 2;
-  bool track_dirty[kDirtyConsumers] = {false, false};
+  // PER-CONSUMER baselines: the serving publisher (consumer 0), the
+  // delta flash checkpointer (consumer 1) and the paged shm tier
+  // (consumer 2) drain their deltas on independent cadences — one
+  // shared set would let any plane silently clear rows out of
+  // another's next delta.  Each consumer arms and clears only its
+  // own slot; mutations mark every armed slot.
+  static constexpr int kDirtyConsumers = 3;
+  bool track_dirty[kDirtyConsumers] = {false, false, false};
   std::unordered_set<int64_t> dirty[kDirtyConsumers];
   std::unordered_set<int64_t> dead[kDirtyConsumers];
 

@@ -10,11 +10,26 @@ unavailable.
 """
 
 import ctypes
+import os
 from typing import Optional
 
 import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
+
+
+def save_workers() -> int:
+    """Thread count for the save-side chunked parallel memcpy
+    (``DLROVER_SAVE_WORKERS``; the twin of the restore pipeline's
+    ``DLROVER_RESTORE_WORKERS``).  1 means exact serial copies.
+    Default sizes like the restore pool: half the cores, capped."""
+    env = os.environ.get("DLROVER_SAVE_WORKERS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return min(8, max(2, (os.cpu_count() or 2) // 2))
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False

@@ -1,0 +1,529 @@
+"""Paged shm tier tests (ISSUE 18): O(rows-touched) hot saves with
+base+delta pages in shared memory.
+
+Covers the crash-consistency and equivalence contracts the tier is
+built on:
+
+- paged restore is BIT-IDENTICAL to a flat full-segment twin of the
+  same final state, on DRAM-only and spill-active sparse tables,
+  property-pinned across pathological memcpy chunk sizes (tiny prime /
+  default / one-shot) and worker counts;
+- a torn page directory is refused: corrupting the active slot falls
+  back to the previous generation, corrupting both refuses the
+  snapshot entirely; a clobbered data page (CRC mismatch) likewise
+  falls back to the generation whose ping-pong extents are intact;
+- SIGKILL between the delta-page write and the directory publish
+  (``ckpt.paged_write`` chaos hook) leaves the segment restoring the
+  previous generation, digest-equal to an uninterrupted control run;
+- a respawned writer ADOPTS the in-segment epoch (meta host died with
+  the trainer) and continues the generation chain;
+- the tier-1 acceptance guard: at ~1% sparse touch a paged save moves
+  >= 10x fewer bytes than the full base, asserted from the
+  ``checkpoint_shm_save`` event stream;
+- the cross-world shm refusal is preserved for paged snapshots.
+
+Numpy-heavy, no device arrays — fast.
+"""
+
+import os
+import pickle
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.saver import (
+    AsyncCheckpointSaver,
+    SaverConfig,
+)
+from dlrover_tpu.checkpoint.shm_handler import (
+    PAGED_MAGIC,
+    _PAGED_HDR,
+    CheckpointConfig,
+    SharedMemoryHandler,
+)
+from dlrover_tpu.checkpoint.sparse import (
+    KV_STATE_KEY,
+    SparseStateAdapter,
+    rows_digest,
+)
+from dlrover_tpu.ops.kv_variable import GroupAdamOptimizer, KvVariable
+
+
+def _mk_adapter(seed=7, n=500, spill_dir=None, dim=4):
+    t = KvVariable(
+        dim=dim, initial_capacity=64, seed=seed, name="emb"
+    )
+    opt = GroupAdamOptimizer(t, learning_rate=1e-2)
+    if spill_dir:
+        t.enable_spill(
+            os.path.join(spill_dir, "emb.spill"), max_dram_rows=80
+        )
+        opt.enable_spill(spill_dir, max_dram_rows=80)
+    adapter = SparseStateAdapter(digest=True)
+    adapter.register_optimizer(opt)
+    return t, opt, adapter
+
+
+def _train_step(t, opt, step, n_keys=500, batch=64):
+    rng = np.random.default_rng(1000 + step)
+    keys = rng.integers(0, n_keys, batch).astype(np.int64)
+    opt.apply_gradients(keys, np.tanh(t.gather(keys)) * 0.1)
+
+
+def _dense(step):
+    rng = np.random.default_rng(100 + step)
+    return {
+        "w": rng.normal(size=(300,)).astype(np.float32),
+        "b": np.full((32,), float(step), np.float32),
+        "frozen": np.arange(64, dtype=np.int32),  # never changes
+        "step": step,
+    }
+
+
+def _kv_rows_sorted(flat, table):
+    """(keys, values, freq) of one table out of a restored flat dict,
+    sorted by key — chain replay is row-order free; content is not."""
+    k = flat[f"{KV_STATE_KEY}/{table}/keys"]
+    v = flat[f"{KV_STATE_KEY}/{table}/values"]
+    f = flat[f"{KV_STATE_KEY}/{table}/freq"]
+    order = np.argsort(k, kind="stable")
+    return k[order], v[order], f[order]
+
+
+def _assert_flat_equal(got, want):
+    """Restored flat dicts equal: dense leaves bit-exact, kv tables
+    content-equal (sorted by key), scalars equal."""
+    kv_tables = set()
+    for d in (got, want):
+        for key in d:
+            if key.startswith(f"{KV_STATE_KEY}/") and key.endswith(
+                "/keys"
+            ):
+                parts = key.split("/")
+                if len(parts) == 3:
+                    kv_tables.add(parts[1])
+    assert set(got) == set(want), (
+        set(got) ^ set(want)
+    )
+    skip = {
+        f"{KV_STATE_KEY}/{t}/{leaf}"
+        for t in kv_tables
+        for leaf in ("keys", "values", "freq")
+    }
+    for t in sorted(kv_tables):
+        kg, vg, fg = _kv_rows_sorted(got, t)
+        kw, vw, fw = _kv_rows_sorted(want, t)
+        np.testing.assert_array_equal(kg, kw, err_msg=t)
+        assert vg.tobytes() == vw.tobytes(), t
+        np.testing.assert_array_equal(fg, fw, err_msg=t)
+    for key in sorted(set(want) - skip):
+        w = want[key]
+        g = got[key]
+        if isinstance(w, (np.ndarray, np.generic)):
+            assert np.asarray(g).tobytes() == np.asarray(
+                w
+            ).tobytes(), key
+        else:
+            assert g == w, key
+
+
+# -- paged vs flat bit-identity ---------------------------------------
+
+
+@pytest.mark.parametrize(
+    "spill,chunk,workers",
+    [
+        (False, "97", "1"),        # 1-row-ish prime-sized chunks
+        (False, "", "4"),          # default chunking, parallel pool
+        (False, "1073741824", "1"),  # one-shot copy
+        (True, "97", "4"),
+        (True, "", "1"),
+    ],
+)
+def test_paged_restore_bit_identical_to_flat_twin(
+    tmp_path, monkeypatch, spill, chunk, workers,
+):
+    """After a base + two delta saves, the paged segment restores
+    bit-identically to a FLAT full save of the same final state —
+    the property every downstream consumer (restore, agent persist)
+    stands on, pinned across chunk/worker extremes."""
+    if chunk:
+        monkeypatch.setenv("DLROVER_SAVE_CHUNK_BYTES", chunk)
+    else:
+        monkeypatch.delenv("DLROVER_SAVE_CHUNK_BYTES", raising=False)
+    monkeypatch.setenv("DLROVER_SAVE_WORKERS", workers)
+    tag = f"pgbit{int(spill)}{chunk or 'd'}{workers}"
+
+    spill_a = str(tmp_path / "a") if spill else None
+    spill_b = str(tmp_path / "b") if spill else None
+    if spill:
+        os.makedirs(spill_a)
+        os.makedirs(spill_b)
+    t1, o1, a1 = _mk_adapter(spill_dir=spill_a)
+    t2, o2, a2 = _mk_adapter(spill_dir=spill_b)
+
+    paged = SharedMemoryHandler(0, host=True, job_name=f"{tag}p")
+    flat_h = SharedMemoryHandler(0, host=True, job_name=f"{tag}f")
+    try:
+        for step in (1, 2, 3):
+            _train_step(t1, o1, step)
+            kind, kv = a1.export_for_shm(step=step, rank=0)
+            assert kind == ("base" if step == 1 else "delta")
+            paged.save_state_dict_paged(
+                _dense(step), CheckpointConfig(step=step),
+                kv_payload=(kind, kv),
+            )
+            # twin trains identically; it saves once, flat, at the end
+            _train_step(t2, o2, step)
+        if spill:
+            assert t1.spill_stats()["disk_rows"] > 0  # tier ACTIVE
+        assert paged.last_save_phases["kind"] == "delta"
+        assert paged.last_save_phases["bytes_skipped"] > 0  # "frozen"
+        assert paged.paged_generation() == 3
+
+        state = dict(_dense(3))
+        state[KV_STATE_KEY] = a2.export_state(step=3, rank=0)
+        flat_h.save_state_dict(state, CheckpointConfig(step=3))
+
+        cfg_p, got, _ = paged.load_flat()
+        cfg_f, want, _ = flat_h.load_flat()
+        assert cfg_p is not None and cfg_p.step == 3
+        assert cfg_f is not None and cfg_f.step == 3
+        _assert_flat_equal(got, want)
+    finally:
+        paged.unlink()
+        flat_h.unlink()
+
+
+# -- torn-directory / torn-page refusal --------------------------------
+
+
+def _paged_two_generations(tmp_path, tag):
+    """A handler with gen-1 (base) and gen-2 (delta) published, plus
+    the per-generation dense payloads for later comparison."""
+    t, o, a = _mk_adapter()
+    h = SharedMemoryHandler(0, host=True, job_name=tag)
+    for step in (1, 2):
+        _train_step(t, o, step)
+        kind, kv = a.export_for_shm(step=step, rank=0)
+        h.save_state_dict_paged(
+            _dense(step), CheckpointConfig(step=step),
+            kv_payload=(kind, kv),
+        )
+    assert h.paged_generation() == 2
+    return h
+
+
+def _corrupt_slot(h, slot):
+    buf = h._shm.buf
+    (dir_cap,) = struct.unpack_from("<I", buf, 12)
+    off = _PAGED_HDR + slot * dir_cap
+    # stomp the pickled payload, leaving the recorded CRC stale
+    buf[off + 8:off + 24] = b"\xff" * 16
+
+
+def test_torn_directory_falls_back_then_refuses(tmp_path):
+    h = _paged_two_generations(tmp_path, "pgtorn")
+    try:
+        active = h._paged_active_slot()
+        assert active in (0, 1)
+        _corrupt_slot(h, active)
+        # active slot torn -> the previous generation restores
+        d = h._read_paged_directory()
+        assert d is not None and d["generation"] == 1
+        cfg, flat, _ = h.load_flat()
+        assert cfg is not None and cfg.step == 1
+        assert flat["b"][0] == 1.0  # gen-1 dense payload, not gen-2
+        # both slots torn -> the snapshot is refused outright
+        _corrupt_slot(h, 1 - active)
+        assert h._read_paged_directory() is None
+        cfg, flat, _ = h.load_flat()
+        assert cfg is None and flat == {}
+    finally:
+        h.unlink()
+
+
+def test_clobbered_data_page_falls_back_previous_generation(
+    tmp_path,
+):
+    """A generation whose referenced page bytes fail their CRC must
+    not restore — the fallback generation's ping-pong extents are
+    untouched by the newer write, so it still verifies."""
+    h = _paged_two_generations(tmp_path, "pgcrc")
+    try:
+        d = h._read_paged_directory()
+        assert d["generation"] == 2
+        leaf = d["leaves"]["b"]  # changed every step -> sides differ
+        off = (
+            leaf["off_a"] if int(leaf["active"]) == 0
+            else leaf["off_b"]
+        )
+        h._shm.buf[off:off + 8] = b"\xff" * 8
+        d = h._read_paged_directory()  # page CRC fails -> fall back
+        assert d is not None and d["generation"] == 1
+        cfg, flat, _ = h.load_flat()
+        assert cfg.step == 1 and flat["b"][0] == 1.0
+    finally:
+        h.unlink()
+
+
+def test_respawned_writer_adopts_epoch(tmp_path):
+    """A fresh handler (trainer respawn: no writer-side directory
+    cache) adopts the in-segment epoch: the next save is still a
+    delta-sized write and the generation chain continues."""
+    t, o, a = _mk_adapter()
+    h1 = SharedMemoryHandler(0, host=True, job_name="pgadopt")
+    for step in (1, 2):
+        _train_step(t, o, step)
+        kind, kv = a.export_for_shm(step=step, rank=0)
+        h1.save_state_dict_paged(
+            _dense(step), CheckpointConfig(step=step),
+            kv_payload=(kind, kv),
+        )
+    h2 = SharedMemoryHandler(0, host=False, job_name="pgadopt")
+    try:
+        assert h2._paged_dir is None
+        _train_step(t, o, 3)
+        kind, kv = a.export_for_shm(step=3, rank=0)
+        assert kind == "delta"  # the adapter chain survived too
+        phases = h2.save_state_dict_paged(
+            _dense(3), CheckpointConfig(step=3),
+            kv_payload=(kind, kv),
+        )
+        assert phases["kind"] == "delta"
+        assert phases["generation"] == 3
+        assert phases["bytes_skipped"] > 0  # adopted extents compared
+        cfg, flat, _ = h2.load_flat()
+        assert cfg.step == 3
+        k, v, f = _kv_rows_sorted(flat, "emb")
+        ks, vs, fs = t.export()
+        order = np.argsort(ks, kind="stable")
+        assert rows_digest(k, v, f) == rows_digest(
+            ks[order], vs[order], fs[order]
+        )
+    finally:
+        h1.unlink()
+
+
+# -- SIGKILL between page write and directory publish ------------------
+
+
+_CHILD = r"""
+import os, sys
+import numpy as np
+
+role, out = sys.argv[1], sys.argv[2]
+
+from dlrover_tpu.checkpoint.sparse import SparseStateAdapter
+from dlrover_tpu.ops.kv_variable import GroupAdamOptimizer, KvVariable
+
+t = KvVariable(dim=4, initial_capacity=64, seed=7, name="emb")
+opt = GroupAdamOptimizer(t, learning_rate=1e-2)
+adapter = SparseStateAdapter(digest=True)
+adapter.register_optimizer(opt)
+
+def train(step):
+    rng = np.random.default_rng(1000 + step)
+    keys = rng.integers(0, 500, 64).astype(np.int64)
+    opt.apply_gradients(keys, np.tanh(t.gather(keys)) * 0.1)
+
+def dense(step):
+    rng = np.random.default_rng(100 + step)
+    return {"w": rng.normal(size=(300,)).astype(np.float32),
+            "b": np.full((32,), float(step), np.float32),
+            "step": step}
+
+if role == "control":
+    # the uninterrupted twin, stopped where the victim's last
+    # PUBLISHED generation stopped
+    for step in (1, 2):
+        train(step)
+    k, v, f = t.export()
+    order = np.argsort(k, kind="stable")
+    np.savez(out, keys=k[order], values=v[order], freq=f[order])
+    sys.exit(0)
+
+from dlrover_tpu import chaos
+from dlrover_tpu.checkpoint.shm_handler import (
+    CheckpointConfig, SharedMemoryHandler,
+)
+
+chaos.install(chaos.Scenario(name="kill-mid-page", seed=1, rules=[
+    chaos.Rule(point="ckpt.paged_write", action="kill", at_step=3),
+]))
+handler = SharedMemoryHandler(0, host=True)
+for step in (1, 2, 3):
+    train(step)
+    kind, kv = adapter.export_for_shm(step=step, rank=0)
+    handler.save_state_dict_paged(
+        dense(step), CheckpointConfig(step=step),
+        kv_payload=(kind, kv),
+    )
+# unreachable: the rule SIGKILLs inside the step-3 save
+sys.exit(7)
+"""
+
+
+def test_sigkill_mid_page_write_restores_previous_generation(
+    tmp_path, monkeypatch,
+):
+    """ISSUE 18 acceptance: SIGKILL lands between the delta-page
+    write and the directory publish; the segment (meta host dead)
+    still restores the PREVIOUS generation, digest-equal to an
+    uninterrupted control run stopped at the same step."""
+    import dlrover_tpu
+
+    job = "pgkill"
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    control_npz = tmp_path / "control.npz"
+    pkg_root = os.path.dirname(os.path.dirname(dlrover_tpu.__file__))
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", DLROVER_JOB_NAME=job,
+        PYTHONPATH=pkg_root + os.pathsep + os.environ.get(
+            "PYTHONPATH", ""
+        ),
+    )
+    victim = subprocess.run(  # noqa: S603
+        [sys.executable, str(script), "victim", "-"],
+        env=env, timeout=120,
+    )
+    assert victim.returncode == -9, victim.returncode  # SIGKILLed
+    control = subprocess.run(  # noqa: S603
+        [sys.executable, str(script), "control", str(control_npz)],
+        env=env, timeout=120,
+    )
+    assert control.returncode == 0
+
+    # the reader side: a fresh process would host its own (empty)
+    # meta dict — the paged segment must stand alone
+    monkeypatch.setenv("DLROVER_JOB_NAME", job)
+    h = SharedMemoryHandler(0, host=True)
+    try:
+        assert h.paged_generation() == 2  # gen 3 never published
+        cfg, flat, _ = h.load_flat()
+        assert cfg is not None and cfg.step == 2
+        np.testing.assert_array_equal(flat["b"], np.full(32, 2.0))
+        want = np.load(control_npz)
+        k, v, f = _kv_rows_sorted(flat, "emb")
+        assert rows_digest(k, v, f) == rows_digest(
+            want["keys"], want["values"], want["freq"]
+        )
+        assert v.tobytes() == want["values"].tobytes()
+    finally:
+        h.unlink()
+
+
+# -- engine integration: the >=10x byte-reduction guard ----------------
+
+
+def test_paged_save_moves_10x_fewer_bytes_at_one_percent_touch(
+    tmp_path, monkeypatch,
+):
+    """ISSUE 18 acceptance (tier-1): at ~1% sparse touch the paged
+    delta save moves >= 10x fewer bytes than the full base — asserted
+    from the ``checkpoint_shm_save`` event stream, the same surface
+    operators monitor."""
+    from dlrover_tpu.checkpoint.sparse import KV_STATE_KEY as KVK
+    from dlrover_tpu.telemetry.events import EVENT_LOG_ENV, read_events
+
+    evlog = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv(EVENT_LOG_ENV, evlog)
+    monkeypatch.setenv("DLROVER_SHM_PAGED", "1")
+    monkeypatch.setenv("DLROVER_JOB_NAME", "pg10x")
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+    AsyncCheckpointSaver.reset()
+    t, opt, adapter = _mk_adapter(n=5000, dim=8)
+    all_keys = np.arange(5000, dtype=np.int64)
+    opt.apply_gradients(
+        all_keys, np.tanh(t.gather(all_keys)) * 0.1
+    )
+    engine = CheckpointEngine(
+        str(tmp_path / "ckpt"), replicated=True, local_rank=0,
+        global_rank=0, world_size=1,
+    )
+    engine.register_sparse(adapter)
+    dense = {"w": np.zeros(4096, np.float32), "step": 0}
+    try:
+        assert engine.save_to_memory(1, dense)
+        touched = np.arange(0, 5000, 100, dtype=np.int64)  # 1%
+        opt.apply_gradients(
+            touched, np.tanh(t.gather(touched)) * 0.1
+        )
+        assert engine.save_to_memory(2, dense)
+
+        ev = [
+            e for e in read_events(evlog)
+            if e.get("type") == "checkpoint_shm_save"
+        ]
+        assert len(ev) == 2
+        base, delta = ev
+        assert base["paged"] is True and base["kind"] == "base"
+        assert delta["kind"] == "delta"
+        assert base["generation"] + 1 == delta["generation"]
+        assert delta["pages_written"] >= 1
+        assert delta["bytes_skipped"] > 0  # dense leaves unchanged
+        assert base["bytes"] >= 10 * delta["bytes"], (
+            f"paged delta moved {delta['bytes']} bytes vs base "
+            f"{base['bytes']}: < 10x reduction at 1% touch"
+        )
+
+        # the paged fields are REGISTERED schema, not drift
+        from dlrover_tpu.telemetry.check_events import check_logs
+
+        assert check_logs([evlog]) == []
+
+        # and the snapshot restores: table rolled back to save-time
+        snap_k, snap_v, snap_f = t.export()
+        order = np.argsort(snap_k, kind="stable")
+        want = rows_digest(
+            snap_k[order], snap_v[order], snap_f[order]
+        )
+        _train_step(t, opt, 99)  # diverge
+        step, state = engine.load()
+        assert step == 2
+        assert KVK not in state
+        k, v, f = t.export()
+        o2 = np.argsort(k, kind="stable")
+        assert rows_digest(k[o2], v[o2], f[o2]) == want
+        assert engine.last_restore_phases["tier"] == "shm"
+    finally:
+        engine._shm_handler.unlink()
+        engine.close()
+        AsyncCheckpointSaver.reset()
+
+
+def test_paged_shm_refused_across_worlds(tmp_path, monkeypatch):
+    """The cross-world rule survives paging: a paged snapshot written
+    by a world-2 rank is per-node state — a world-1 restore with a
+    sparse adapter registered must skip the shm tier."""
+    monkeypatch.setenv("DLROVER_SHM_PAGED", "1")
+    monkeypatch.setenv("DLROVER_JOB_NAME", "pgxw")
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+    t, opt, adapter = _mk_adapter()
+    _train_step(t, opt, 1)
+    h = SharedMemoryHandler(0, host=True)
+    kind, kv = adapter.export_for_shm(step=1, rank=0)
+    h.save_state_dict_paged(
+        _dense(1), CheckpointConfig(step=1, world_size=2),
+        kv_payload=(kind, kv),
+    )
+    AsyncCheckpointSaver.reset()
+    engine = CheckpointEngine(
+        str(tmp_path / "ckpt"), replicated=True, local_rank=0,
+        global_rank=0, world_size=1,
+    )
+    engine.register_sparse(adapter)
+    try:
+        step, _state = engine.load()
+        assert step is None  # shm refused; no storage tier exists
+        assert engine.last_restore_phases.get("tier") != "shm"
+    finally:
+        h.unlink()
+        engine.close()
+        AsyncCheckpointSaver.reset()

@@ -569,6 +569,48 @@ def test_resize_invariants_on_synthetic_trail():
     assert not res.ok
 
 
+def test_bounded_step_loss_commit_aware():
+    """A restart may lose more than one disk interval when the loop
+    outran the commit cadence — excused iff it resumed exactly from
+    the newest durable commit that existed when it booted."""
+    from dlrover_tpu.chaos import harness
+
+    def step(s, rank, count, ts):
+        return {"type": "train_step", "step": s, "node_rank": rank,
+                "restart_count": count, "ts": ts}
+
+    def restart(rank, count, ts):
+        return {"type": "worker_restart", "node_rank": rank,
+                "restart_count": count, "ts": ts}
+
+    def commit(s, ts):
+        return {"type": "checkpoint_commit", "step": s, "ts": ts,
+                "source": "agent"}
+
+    # committed step 3, then stepped ahead to 9 before the kill:
+    # resuming from 4 loses 6 > interval 3, but step 3 WAS the
+    # newest durable commit at boot time — excused
+    ev = ([step(s, 0, 0, float(s)) for s in range(1, 10)]
+          + [commit(3, 3.5), restart(0, 1, 10.0)]
+          + [step(s, 0, 1, 10.0 + s) for s in range(4, 12)])
+    res = harness.BoundedStepLossPerRestart(interval=3).check(ev, None)
+    assert res.ok, res.detail
+    # a commit at step 6 existed before the reboot: resuming from 4
+    # is a stale restore, not cadence outrun — still fails
+    res = harness.BoundedStepLossPerRestart(interval=3).check(
+        ev + [commit(6, 6.5)], None
+    )
+    assert not res.ok
+    # resuming AHEAD of recorded progress always fails
+    ahead = ([step(s, 0, 0, float(s)) for s in range(1, 5)]
+             + [commit(3, 3.5), restart(0, 1, 5.0)]
+             + [step(s, 0, 1, 5.0 + s) for s in range(6, 9)])
+    res = harness.BoundedStepLossPerRestart(interval=3).check(
+        ahead, None
+    )
+    assert not res.ok
+
+
 def test_loss_trajectory_invariant():
     from dlrover_tpu.chaos import harness
 
