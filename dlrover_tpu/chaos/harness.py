@@ -2024,6 +2024,67 @@ class GoodputLossAttributed(Invariant):
         )
 
 
+class GoodputConservation(Invariant):
+    """Goodput-ledger invariant: the per-incarnation wall-clock
+    partition must CLOSE — every incarnation's attributed categories
+    sum to its measured wall clock within ``eps`` (default 2%).  An
+    attribution the ledger cannot explain is a bug, not a rounding
+    error.  With ``named_floor`` > 0 the scenario additionally proves
+    causality: at least that fraction of total non-productive time
+    must land in NAMED categories (not ``idle_unattributed``) — the
+    worker-kill scenarios assert 90%, i.e. the death-witness ->
+    rendezvous -> restore -> first-step chain was actually observed.
+    Runs whose ledger has no incarnations (no step/restart telemetry
+    at all) pass vacuously; the floor is only enforced once there is
+    ``min_loss_s`` of non-productive time to explain."""
+
+    name = "goodput_conservation"
+
+    def __init__(self, eps: float = 0.02,
+                 named_floor: float = 0.0,
+                 min_loss_s: float = 1.0):
+        self.eps = eps
+        self.named_floor = named_floor
+        self.min_loss_s = min_loss_s
+
+    def check(self, events, run):
+        from dlrover_tpu.telemetry import goodput as _goodput
+
+        ledger = _goodput.build_ledger(events)
+        if not ledger.incarnations:
+            return InvariantResult(
+                self.name, True, "no incarnations in ledger"
+            )
+        errors = ledger.conservation_errors(self.eps)
+        if errors:
+            return InvariantResult(
+                self.name, False,
+                "conservation violated: " + "; ".join(errors),
+            )
+        loss = ledger.loss_totals()
+        nonprod = sum(loss.values())
+        detail = (
+            f"{len(ledger.incarnations)} incarnation(s) close "
+            f"within {self.eps:.0%}"
+        )
+        if self.named_floor > 0 and nonprod >= self.min_loss_s:
+            named = nonprod - loss.get(_goodput.IDLE, 0.0)
+            frac = named / nonprod
+            if frac < self.named_floor:
+                return InvariantResult(
+                    self.name, False,
+                    f"only {frac:.0%} of {nonprod:.3f}s "
+                    f"non-productive time named (< "
+                    f"{self.named_floor:.0%}; totals: "
+                    f"{ {k: round(v, 3) for k, v in loss.items() if v > 0} })",
+                )
+            detail += (
+                f"; {frac:.0%} of {nonprod:.3f}s non-productive "
+                f"time named"
+            )
+        return InvariantResult(self.name, True, detail)
+
+
 class NodeCompletedSteps(Invariant):
     """Per-node progress in a multi-agent run: node ``rank`` stepped
     through at least ``total_steps`` (train_step events carry
@@ -2312,16 +2373,21 @@ def _build_report(
 
 
 def default_invariants(
-    total_steps: int, ckpt_every: int, workdir: str
+    total_steps: int, ckpt_every: int, workdir: str,
+    goodput_named_floor: float = 0.0,
 ) -> List[Invariant]:
     """The full recovery set — appropriate for scenarios whose fault
-    is expected to crash a worker."""
+    is expected to crash a worker.  Every recovery scenario also
+    proves its goodput accounting CLOSES (conservation within 2% per
+    incarnation); pass ``goodput_named_floor`` to additionally demand
+    that fraction of non-productive time land in named categories."""
     return [
         WorkerRestarted(),
         RendezvousReconverged(),
         BoundedStepLoss(ckpt_interval=ckpt_every),
         TrainingCompleted(total_steps=total_steps),
         NoOrphanProcesses(marker=workdir),
+        GoodputConservation(named_floor=goodput_named_floor),
     ]
 
 
@@ -2352,6 +2418,10 @@ def invariants_for_scenario(
                 min_attributed_frac=0.5,
                 expect_cause=flight.CAUSE_MASTER_RECOVERY,
             ),
+            # the ledger's per-incarnation accounting must still
+            # close across the control-plane outage (the silent gap
+            # lands in idle_unattributed, never breaks conservation)
+            GoodputConservation(),
             NoOrphanProcesses(marker=workdir),
         ]
     if name == "warm-recovery-cache-hit":
@@ -2532,6 +2602,7 @@ def invariants_for_scenario(
                 os.path.join(workdir, "router_journal"),
                 os.path.join(workdir, "router_table_live.json"),
             ),
+            GoodputConservation(),
             NoOrphanProcesses(marker=workdir),
         ]
     if name == "serving-trainer-kill-midpublish":
@@ -2555,7 +2626,13 @@ def invariants_for_scenario(
             NoOrphanProcesses(marker=workdir),
         ]
     if name in RECOVERY_SCENARIOS:
-        return default_invariants(total_steps, ckpt_every, workdir)
+        # the worker-kill trail must also NAME >=90% of its
+        # non-productive time (death witness -> rendezvous ->
+        # restore -> first step), not dump it in idle_unattributed
+        return default_invariants(
+            total_steps, ckpt_every, workdir,
+            goodput_named_floor=0.9,
+        )
     return [
         TrainingCompleted(total_steps=total_steps),
         NoOrphanProcesses(marker=workdir),
@@ -3447,6 +3524,9 @@ def elastic_resize_invariants(
             min_attributed_frac=0.5,
             expect_cause=flight.CAUSE_RESIZE,
         ),
+        # overlapping incarnations (old world draining while the new
+        # world rendezvouses) must still each close their books
+        GoodputConservation(),
         NoOrphanProcesses(marker=workdir),
     ]
 
@@ -3470,6 +3550,7 @@ def sparse_resize_invariants(
         BoundedStepLossPerRestart(interval=disk_every),
         KvReshardExactlyOnce(min_reshards=2),
         FinalStepCommitted(),
+        GoodputConservation(),
         NoOrphanProcesses(marker=workdir),
     ]
 

@@ -72,6 +72,7 @@ _BRAIN_INGESTS_TOTAL = get_registry().counter(
 BRAIN_DB_ENV = "DLROVER_BRAIN_DB"
 BRAIN_INGEST_INTERVAL_ENV = "DLROVER_BRAIN_INGEST_INTERVAL_S"
 BRAIN_RESIZE_ENV = "DLROVER_BRAIN_RESIZE"
+GOODPUT_LEDGER_INTERVAL_ENV = "DLROVER_GOODPUT_LEDGER_INTERVAL_S"
 
 
 class JobMaster:
@@ -217,6 +218,25 @@ class JobMaster:
                 )
                 self.brain_store = None
                 self.brain = None
+        # -- goodput ledger (causal wall-clock attribution) ------------
+        self.goodput_ledger = None
+        ledger_interval = _env_float(
+            GOODPUT_LEDGER_INTERVAL_ENV, 30.0
+        )
+        if ledger_interval > 0:
+            try:
+                from dlrover_tpu.master.goodput_ledger import (
+                    GoodputLedgerService,
+                )
+
+                self.goodput_ledger = GoodputLedgerService(
+                    speed_monitor=self.speed_monitor,
+                    interval=ledger_interval,
+                )
+            except Exception:  # noqa: BLE001 - accounting must
+                logger.exception(  # never kill the master
+                    "goodput ledger service unavailable"
+                )
         # -- crash recovery: state journal + replay --------------------
         self.journal: Optional[StateJournal] = None
         jdir = journal_dir or os.getenv(JOURNAL_DIR_ENV, "")
@@ -383,6 +403,23 @@ class JobMaster:
             logger.exception("brain ingest failed")  # not kill us
             return False
 
+    def maybe_goodput_ledger(
+        self, now: Optional[float] = None, force: bool = False
+    ) -> bool:
+        """Throttled goodput-ledger tick: re-assemble the attribution
+        from the event logs, publish the category counters, and
+        re-derive ``SpeedMonitor.goodput()``.  Accounting must never
+        kill the master."""
+        if self.goodput_ledger is None:
+            return False
+        try:
+            if force:
+                return self.goodput_ledger.tick(now)
+            return self.goodput_ledger.maybe_tick(now)
+        except Exception:  # noqa: BLE001
+            logger.exception("goodput ledger tick failed")
+            return False
+
     def update_rdzv_params(
         self, min_nodes: int, max_nodes: int, node_unit: int = 1
     ):
@@ -470,6 +507,9 @@ class JobMaster:
                 # standing-optimizer feed: event logs + throughput
                 # snapshots into the Brain datastore on a cadence
                 self.maybe_brain_ingest()
+                # goodput ledger: causal wall-clock attribution from
+                # the event logs, on its own cadence
+                self.maybe_goodput_ledger()
                 # inference-chain diagnosis over the agents' reported
                 # evidence (stacks, hang flight data, per-node step
                 # times, step-phase breakdowns) — the hang verdict
@@ -526,6 +566,10 @@ class JobMaster:
                         break
         finally:
             self.stop()
+            # short jobs may never cross the ledger cadence: force a
+            # final assembly so master_exit stamps the end-of-job
+            # attribution, not a mid-recovery snapshot
+            self.maybe_goodput_ledger(force=True)
             emit_event(
                 "master_exit",
                 job=self.job_name,

@@ -44,6 +44,12 @@ class SpeedMonitor:
         self._workers_gauge = reg.gauge(
             "dlrover_running_workers", "Workers currently registered"
         )
+        # step-gap ratio kept as a cross-check against the
+        # ledger-derived goodput (divergence >1% is an event)
+        self._monitor_goodput_gauge = reg.gauge(
+            "dlrover_goodput_ratio_monitor",
+            "Step-gap goodput ratio (pre-ledger cross-check)",
+        )
         # a fresh monitor is a fresh job: zero the registry view
         self._step_gauge.set(0)
         self._speed_gauge.set(0.0)
@@ -79,6 +85,13 @@ class SpeedMonitor:
         # steps 4x slower) shifts it within a window's worth of steps
         # — an EMA that skips outliers would freeze instead
         self._gap_window: Deque[float] = deque(maxlen=64)
+        # event-log goodput ledger override: when the master's ledger
+        # service has a fresh cross-process attribution, goodput() is
+        # re-derived from it (the step-gap ratio stays available as
+        # legacy_goodput() and on the *_monitor gauge)
+        self._ledger_goodput: Optional[float] = None
+        self._ledger_goodput_ts = 0.0
+        self._ledger_ttl = 120.0
 
     def set_batch_size(self, batch_size: int):
         self._batch_size = batch_size
@@ -186,12 +199,39 @@ class SpeedMonitor:
             return 0.0
         return min(1.0, self._productive_seconds / wall)
 
+    def legacy_goodput(self) -> float:
+        """The monitor's own step-gap ratio, bypassing any ledger
+        override — the cross-check side of the divergence event."""
+        with self._lock:
+            return self._goodput_locked()
+
+    def set_ledger_goodput(
+        self, ratio: float, ts: Optional[float] = None
+    ):
+        """Install the event-log ledger's goodput as the value
+        ``goodput()`` reports.  The override expires after
+        ``_ledger_ttl`` seconds without refresh, so a dead ledger
+        service degrades back to the step-gap ratio instead of
+        freezing the metric."""
+        with self._lock:
+            self._ledger_goodput = max(0.0, min(1.0, float(ratio)))
+            self._ledger_goodput_ts = ts or time.time()
+
     def goodput(self) -> float:
         """Fraction of training wall-clock spent making step progress
         — the north-star metric under churn (reference claim: 69% ->
-        95% with fault tolerance + flash ckpt, README.md:55-57)."""
+        95% with fault tolerance + flash ckpt, README.md:55-57).
+        Re-derived from the goodput ledger when the master's ledger
+        service keeps it fresh; the step-gap ratio otherwise."""
         with self._lock:
-            ratio = self._goodput_locked()
+            monitor = self._goodput_locked()
+            self._monitor_goodput_gauge.set(monitor)
+            ratio = monitor
+            if self._ledger_goodput is not None and (
+                time.time() - self._ledger_goodput_ts
+                <= self._ledger_ttl
+            ):
+                ratio = self._ledger_goodput
             self._goodput_gauge.set(ratio)
             return ratio
 

@@ -416,9 +416,12 @@ class EmbeddingPublisher:
         """Write-side twin of the replica's ``_NpyStream``: assemble
         the base blob zip member-by-member, the values column
         streamed straight off :meth:`KvVariable.export_chunks`
-        windows.  Peak extra memory is ONE window plus the 16 B/row
-        key+freq sidecars — never the full value matrix copy (plus
-        its npz serialization) the in-memory path costs.  The
+        windows and the key/freq sidecars spooled to disk during the
+        SAME pass (row alignment survives concurrent mutation), then
+        replayed window-by-window into their members.  Peak extra
+        memory is a couple of export windows — never the full value
+        matrix copy (plus its npz serialization) the in-memory path
+        costs, and not even the 16 B/row sidecar accumulation.  The
         manifest digest accumulates per window (``rows_digest`` sums
         mod 2**64 over disjoint row sets), so replicas verify the
         streamed blob exactly like a materialized one.  Same commit
@@ -467,57 +470,72 @@ class EmbeddingPublisher:
                     n = len(table)
                     dim = int(table.dim)
                     window = reshard_window_rows(dim * 4 + 16)
-                    key_parts, freq_parts = [], []
                     digest = 0
+                    # the key/freq sidecars must come off the SAME
+                    # export pass as the values (a second pass could
+                    # interleave with mutation and misalign rows), so
+                    # spool them to disk during the value stream and
+                    # replay them window-by-window into their zip
+                    # members — peak extra RSS stays one window, not
+                    # 16 B/row (+ the concatenate copy) of sidecars
+                    kspool = tempfile.TemporaryFile(dir=gen_dir)
+                    fspool = tempfile.TemporaryFile(dir=gen_dir)
+                    sidecar_rows = 0
 
-                    def value_blocks(table=table, window=window):
-                        nonlocal digest
+                    def value_blocks(table=table, window=window,
+                                     kspool=kspool, fspool=fspool):
+                        nonlocal digest, sidecar_rows
                         for k, v, f in table.export_chunks(window):
-                            key_parts.append(
-                                np.ascontiguousarray(
-                                    k, dtype=np.int64
-                                )
+                            k = np.ascontiguousarray(
+                                k, dtype=np.int64
                             )
-                            freq_parts.append(
-                                np.ascontiguousarray(
-                                    f, dtype=np.uint64
-                                )
+                            f = np.ascontiguousarray(
+                                f, dtype=np.uint64
                             )
+                            kspool.write(memoryview(k).cast("B"))
+                            fspool.write(memoryview(f).cast("B"))
+                            sidecar_rows += int(k.size)
                             digest = (
                                 digest + rows_digest(k, v, f)
                             ) % 2**64
                             yield v
                             k = v = f = None
 
-                    got = write_member(
-                        zf, f"{name}::values.npy", np.float32,
-                        (n, dim), value_blocks(),
-                    )
-                    keys = (
-                        np.concatenate(key_parts) if key_parts
-                        else np.empty(0, dtype=np.int64)
-                    )
-                    freq = (
-                        np.concatenate(freq_parts) if freq_parts
-                        else np.empty(0, dtype=np.uint64)
-                    )
-                    if got != n or int(keys.size) != n:
-                        # the values header already promised n rows;
-                        # a mismatched stream would commit a blob the
-                        # replica reads torn — refuse the publish
-                        raise RuntimeError(
-                            f"streamed base export of table {name!r}"
-                            f" saw {got} row(s), the logical table "
-                            f"claims {n} — mutation mid-publish?"
+                    def spool_blocks(spool, dtype, window=window):
+                        spool.seek(0)
+                        while True:
+                            buf = spool.read(max(window, 1) * 8)
+                            if not buf:
+                                return
+                            yield np.frombuffer(buf, dtype=dtype)
+
+                    try:
+                        got = write_member(
+                            zf, f"{name}::values.npy", np.float32,
+                            (n, dim), value_blocks(),
                         )
-                    write_member(
-                        zf, f"{name}::keys.npy", np.int64, (n,),
-                        [keys],
-                    )
-                    write_member(
-                        zf, f"{name}::freq.npy", np.uint64, (n,),
-                        [freq],
-                    )
+                        if got != n or sidecar_rows != n:
+                            # the values header already promised n
+                            # rows; a mismatched stream would commit
+                            # a blob the replica reads torn — refuse
+                            # the publish
+                            raise RuntimeError(
+                                f"streamed base export of table "
+                                f"{name!r} saw {got} row(s), the "
+                                f"logical table claims {n} — "
+                                f"mutation mid-publish?"
+                            )
+                        write_member(
+                            zf, f"{name}::keys.npy", np.int64, (n,),
+                            spool_blocks(kspool, np.int64),
+                        )
+                        write_member(
+                            zf, f"{name}::freq.npy", np.uint64,
+                            (n,), spool_blocks(fspool, np.uint64),
+                        )
+                    finally:
+                        kspool.close()
+                        fspool.close()
                     write_member(
                         zf, f"{name}::dead.npy", np.int64, (0,), [],
                     )

@@ -57,6 +57,10 @@ class TrainingEventExporter:
         self._backups = max(1, backups)
         self._source = source
         self._lock = threading.Lock()
+        # deferred witness of a contended (unserialized) rotation;
+        # emitted outside the lock — see emit()/_maybe_rotate()
+        self._contended_rotate: Optional[str] = None
+        self._in_contended_emit = False
 
     # -- configuration -----------------------------------------------------
 
@@ -113,9 +117,24 @@ class TrainingEventExporter:
                 self._maybe_rotate(path, len(line) + 1)
                 with open(path, "a") as f:
                     f.write(line + "\n")
-                return True
+                ok = True
             except OSError:
-                return False
+                ok = False
+        # a contended rotation was noted under the lock; the witness
+        # event must be emitted AFTER release (emit would deadlock on
+        # the non-reentrant lock) and must not recurse through
+        # another contended rotation
+        contended = self._contended_rotate
+        if contended and not self._in_contended_emit:
+            self._contended_rotate = None
+            self._in_contended_emit = True
+            try:
+                self.emit(
+                    "telemetry_rotate_contended", path=contended
+                )
+            finally:
+                self._in_contended_emit = False
+        return ok
 
     def _maybe_rotate(self, path: str, incoming: int):
         limit = self._resolved_max_bytes()
@@ -147,7 +166,13 @@ class TrainingEventExporter:
                     return  # another process already rotated
                 self._rotate(path)
         except OSError:
-            self._rotate(path)  # lock unavailable: best effort
+            # lock unavailable: rotate best-effort, but WITNESS the
+            # race (two unserialized rotators can delete up to
+            # max_bytes of history) instead of staying silent.  The
+            # event itself is deferred to after the exporter lock is
+            # released — see emit().
+            self._rotate(path)
+            self._contended_rotate = path
 
     def _rotate(self, path: str):
         for i in range(self._backups, 0, -1):
@@ -156,6 +181,21 @@ class TrainingEventExporter:
                 os.replace(src, f"{path}.{i}")
             except OSError:
                 pass
+        # os.replace only orders the rename against the directory in
+        # memory: a crash right after rotation may persist the new
+        # backup entries but not the removal/creation of the active
+        # name, orphaning the live segment.  fsync the directory fd
+        # so the whole rename chain is durable before new appends.
+        try:
+            dfd = os.open(
+                os.path.dirname(os.path.abspath(path)), os.O_RDONLY
+            )
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # pragma: no cover - fs without dir fsync
+            pass
 
 
 def read_events(path: str) -> Iterator[Dict]:

@@ -290,6 +290,17 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
             "window_start", "window_end", "window_s", "training_s",
             "loss_s", "goodput", "buckets",
         ]),
+        # periodic goodput-ledger summary published by the master's
+        # ledger service (per-category seconds live in the open dict)
+        _s("goodput_ledger",
+           ["goodput", "attributed_pct", "incarnations", "window_s"],
+           ["top_loss_cause", "wall_s", "totals"]),
+        # ledger-derived goodput vs the SpeedMonitor's step-gap ratio
+        # drifted past the cross-check tolerance (1%)
+        _s("goodput_divergence", ["ledger", "monitor", "divergence"]),
+        # event-log rotation could not take the advisory lock and fell
+        # back to best-effort rotation (possible concurrent rotator)
+        _s("telemetry_rotate_contended", ["path"]),
         # -- fleet observatory ---------------------------------------
         # periodic control-plane scoreboard sample under synthetic
         # fleet load: windowed per-verb latency view + fan-in gauges

@@ -948,6 +948,24 @@ def to_chrome_trace(
             "tid": 0, "s": "g",
             "args": {"detail": _describe_instant(e)},
         })
+    # goodput track: the ledger's per-incarnation category partition
+    # as one Perfetto row per node (lazy import: goodput.py imports
+    # this module for its interval arithmetic)
+    try:
+        from dlrover_tpu.telemetry import goodput as _goodput
+
+        ledger = _goodput.build_ledger(tl.events)
+        for inc in ledger.incarnations:
+            for cat in _goodput.CATEGORIES:
+                for a, b in inc.intervals.get(cat, []):
+                    trace_events.append({
+                        "name": cat, "cat": "goodput", "ph": "X",
+                        "ts": us(a), "dur": max(1, us(b) - us(a)),
+                        "pid": pid("goodput"), "tid": inc.node,
+                        "args": {"incarnation": inc.incarnation},
+                    })
+    except Exception:  # noqa: BLE001 - a ledger bug must not cost
+        pass  # the rest of the trace
     for track, p in tracks.items():
         trace_events.append({
             "ph": "M", "name": "process_name", "pid": p,
@@ -1074,6 +1092,16 @@ def to_report(
         lines.extend(
             "  " + _describe_instant(e) for e in slo_breaches
         )
+    # goodput-ledger section: per-incarnation category partition +
+    # conservation verdict (lazy import — see to_chrome_trace)
+    try:
+        from dlrover_tpu.telemetry import goodput as _goodput
+
+        ledger = _goodput.build_ledger(tl.events)
+        if ledger.incarnations:
+            lines.extend(_goodput.report_lines(ledger))
+    except Exception:  # noqa: BLE001 - a ledger bug must not cost
+        pass  # the rest of the report
     lines.append("incidents:")
     incidents = [
         (s.start, f"[{s.cat}] {s.track}: {s.name} "

@@ -54,6 +54,7 @@ def pytest_collection_modifyitems(config, items):
     fixtures)."""
     early_files = (
         "test_telemetry.py", "test_otlp.py", "test_timeline.py",
+        "test_goodput_ledger.py", "test_event_lint.py",
         "test_deep_diagnosis.py", "test_gcp_monitoring.py",
         "test_bench_guard.py",
         "test_chaos.py",

@@ -121,6 +121,30 @@ def _fat_snapshot() -> dict:
             "iterations": 6,
             "iter_train_s": 0.412345,
         },
+        "goodput_ledger": {
+            "attributed_pct": 95.512345,
+            "top_loss_cause": "compile_trace",
+            "goodput": 0.174512,
+            "incarnations": 2,
+            "wall_s": 9.480123,
+            "conservation_ok": True,
+            # the full per-category sub-dict must NOT leak into the
+            # headline — only the two scalar keys above do
+            "totals_s": {
+                "productive_step": 0.300123,
+                "compile_trace": 7.539123,
+                "restore": 0.098123,
+                "rendezvous": 0.007123,
+                "respawn_gap": 1.087123,
+                "checkpoint_stall": 0.024123,
+                "idle_unattributed": 0.424123,
+            },
+            "top_loss_causes": {
+                "compile_trace": 7.539123,
+                "respawn_gap": 1.087123,
+                "idle_unattributed": 0.424123,
+            },
+        },
         "xl_act_offload": {
             "offload": {"tokens_per_s": 1234.567891},
             "plain_remat_control": {"tokens_per_s": 987.654321},
@@ -147,7 +171,7 @@ def _fat_snapshot() -> dict:
         "input_pipeline", "gqa_attention_kernel", "attention_kernel",
         "elastic_recovery", "serving", "serving_fleet",
         "sparse_scale", "multislice",
-        "sequence_parallel", "rl_elastic",
+        "sequence_parallel", "rl_elastic", "goodput_ledger",
     ]
     for name in sections:
         snap[f"{name}_error"] = "boom " * 50

@@ -511,6 +511,51 @@ def test_streamed_base_publish_memory_guard(tmp_path, monkeypatch):
     np.testing.assert_array_equal(out, values)
 
 
+def test_streamed_base_sidecar_memory_guard(tmp_path, monkeypatch):
+    """The sidecar half of the streamed-base claim: with a tiny
+    embedding dim the key/freq columns are a THIRD of the bytes
+    (16 B/row vs 32 B/row of values), so accumulating them in RAM
+    during the export pass — the pre-spool writer did, at ~32 B/row
+    once the concatenate copy lands — would blow far past the bound.
+    The spooled writer replays them from disk window-by-window, so
+    peak extra RSS stays ≤ 2x the export window even when the
+    sidecars alone total several times that; the replica still serves
+    bit-identical rows off the streamed generation."""
+    from dlrover_tpu.common.env_utils import PeakRssSampler
+    from dlrover_tpu.serving import EmbeddingPublisher, ServingReplica
+
+    rows, dim = 2_500_000, 8
+    window_mb = 8
+    window_rows = int(window_mb * 2**20 / (dim * 4 + 16))
+    monkeypatch.setenv(
+        "DLROVER_KV_RESHARD_WINDOW_ROWS", str(window_rows)
+    )
+    rng = np.random.default_rng(11)
+    keys = np.arange(rows, dtype=np.int64)
+    values = rng.normal(size=(rows, dim)).astype(np.float32)
+    t = KvVariable(dim, name="emb")
+    t.insert(keys, values)
+    a = SparseStateAdapter(digest=True).register_table(t)
+    # sanity: the sidecars alone must dwarf the bound, or this guard
+    # degenerates into the values-path test above
+    bound = 2 * window_mb * 2**20
+    assert rows * 16 > 2 * bound
+    pub = EmbeddingPublisher(a, str(tmp_path / "s_sidecar"))
+    with PeakRssSampler() as rss:
+        gen = pub.publish(step=1)
+    assert rss.peak_extra_bytes <= bound, (
+        f"sidecar-dominant streamed publish peak extra RSS "
+        f"{rss.peak_extra_bytes / 2**20:.1f} MB > 2x window "
+        f"{2 * window_mb} MB"
+    )
+    rep = ServingReplica(str(tmp_path / "s_sidecar"))
+    assert rep.ingest_pending() == [gen]
+    probe = keys[:: max(1, rows // 4096)]
+    np.testing.assert_array_equal(
+        rep.lookup(probe), values[:: max(1, rows // 4096)]
+    )
+
+
 # -- engine round trip with delta chains ---------------------------------
 
 

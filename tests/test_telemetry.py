@@ -284,6 +284,38 @@ def test_event_log_rotation(tmp_path):
     assert all(e["type"] == "tick" for e in live + old)
 
 
+def test_contended_rotation_is_witnessed(tmp_path, monkeypatch):
+    """ISSUE 20 satellite: when the inter-process rotation flock is
+    unavailable the exporter still rotates best-effort, but must
+    WITNESS the unserialized race with a telemetry_rotate_contended
+    event (deferred past the exporter lock — emitting inline would
+    deadlock the non-reentrant lock) instead of silently risking
+    history loss."""
+    import fcntl as real_fcntl
+
+    def _no_flock(fd, op):
+        raise OSError("flock unsupported")
+
+    monkeypatch.setattr(real_fcntl, "flock", _no_flock)
+    path = str(tmp_path / "contended.jsonl")
+    exp = TrainingEventExporter(path=path, max_bytes=200, backups=2)
+    for i in range(30):
+        assert exp.emit("tick", i=i)
+    events = []
+    for p in (path, f"{path}.1", f"{path}.2"):
+        try:
+            events.extend(read_events(p))
+        except FileNotFoundError:
+            pass
+    contended = [
+        e for e in events if e["type"] == "telemetry_rotate_contended"
+    ]
+    assert contended, "contended rotation left no witness event"
+    assert all(e["path"] == path for e in contended)
+    # rotation itself still happened despite the lock failure
+    assert (tmp_path / "contended.jsonl.1").exists()
+
+
 def test_read_events_skips_torn_lines(tmp_path):
     path = tmp_path / "torn.jsonl"
     path.write_text(
